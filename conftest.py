@@ -1,0 +1,25 @@
+"""Root pytest conftest: run the test suite on a simulated multi-device CPU mesh.
+
+This is the TPU-native analog of the reference's ``LocalSparkContext`` fixture
+(/root/reference src/test/.../utils/LocalSparkContext.scala:10-21): the reference
+validates its distributed code paths on a threaded ``local[2]`` Spark backend;
+we validate ours by running the *same* mesh/sharding/collective code paths on an
+8-device CPU platform via ``--xla_force_host_platform_device_count``.
+
+Must run before any test module imports jax.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+# The environment may have a TPU plugin (axon) registered by sitecustomize;
+# explicitly pin tests to the CPU platform regardless.
+jax.config.update("jax_platforms", "cpu")
+# Tests compare against float64 NumPy oracles; enable x64 so CPU math is exact
+# enough for the golden comparisons (TPU runtime uses f32/bf16 — see config).
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,51 @@
+"""marlin_tpu — a TPU-native distributed dense/sparse linear-algebra framework.
+
+A ground-up rebuild of the capabilities of PasaLab/marlin (a Spark-based
+distributed matrix library; see SURVEY.md) designed for TPU: matrices are
+global ``jax.Array``s sharded over a ``jax.sharding.Mesh``, distributed
+multiplies are SPMD programs whose collectives XLA schedules over ICI/DCN, and
+per-block math runs on the MXU instead of netlib BLAS.
+
+Quick start::
+
+    import marlin_tpu as mt
+
+    mesh = mt.create_mesh()                      # all local devices
+    a = mt.DenseVecMatrix.random(0, 8000, 8000, mesh=mesh)
+    b = mt.DenseVecMatrix.random(1, 8000, 8000, mesh=mesh)
+    c = a.multiply(b)                            # adaptive: broadcast vs RMM
+    (l, u, p) = a.lu_decompose(mode="dist")
+"""
+
+from .config import MarlinConfig, config_context, get_config, set_config  # noqa: F401
+from .mesh import (  # noqa: F401
+    COLS,
+    ROWS,
+    create_mesh,
+    default_mesh,
+    initialize_distributed,
+    set_default_mesh,
+)
+from .matrix import (  # noqa: F401
+    BlockMatrix,
+    CoordinateMatrix,
+    DenseMatrix,
+    DenseVecMatrix,
+    DistributedIntVector,
+    DistributedMatrix,
+    DistributedVector,
+    SparseVecMatrix,
+)
+from .parallel import matmul, rmm_matmul, split_method  # noqa: F401
+from .linalg import cholesky_decompose, compute_svd, inverse, lanczos, lu_decompose  # noqa: F401
+from .io import (  # noqa: F401
+    load_block_matrix_file,
+    load_coordinate_matrix,
+    load_matrix_file,
+    load_svm_den_vec_matrix,
+    save_matrix,
+)
+from .utils import evaluate, timer  # noqa: F401
+from . import random  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,72 @@
+"""Global configuration for marlin_tpu.
+
+The reference spreads its knobs over three channels (SURVEY.md §5.6): CLI args,
+SparkConf keys (``marlin.lu.basesize``/``marlin.cholesky.basesize``/
+``marlin.inverse.basesize``, /root/reference matrix/DenseVecMatrix.scala:313,499,591)
+and method parameters with defaults (``broadcastThreshold`` MB,
+DenseVecMatrix.scala:196-198; mode strings on factorizations 283,475,568).
+
+Here all of that is one dataclass with a global instance and a context manager,
+so library calls and CLI examples share the same knob surface.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Any, Iterator
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class MarlinConfig:
+    # Factorization base block sizes (reference defaults: 1000).
+    lu_base_size: int = 1000
+    cholesky_base_size: int = 1000
+    inverse_base_size: int = 1000
+    # Size threshold (matrix dim) below which factorizations run single-device
+    # ("breeze" mode in the reference, DenseVecMatrix.scala:289-298 uses n > 6000).
+    local_fallback_dim: int = 6000
+    # Broadcast-multiply threshold in MB (DenseVecMatrix.scala:196-198 default 300).
+    broadcast_threshold_mb: float = 300.0
+    # Default element dtype for matrices. The reference is float64-on-JVM; the
+    # TPU-native default is float32 storage (bf16 compute happens inside the MXU
+    # via the precision setting below).
+    default_dtype: Any = jnp.float32
+    # Precision for jnp.dot/matmul on the hot path: "default" lets the MXU use
+    # bf16 passes; "highest" forces f32-accurate multiplies (used by tests).
+    matmul_precision: str = "highest"
+    # Number of logical cores/devices hint for the CARMA split heuristic when no
+    # mesh is given (the reference reads spark.default.parallelism,
+    # MTUtils.scala:496-502).
+    default_parallelism: int | None = None
+    # SVD mode thresholds (DenseVecMatrix.scala:1569-1588).
+    svd_local_dim: int = 2000
+    # Lanczos iterations multiplier for dist-eigs SVD.
+    lanczos_max_iter_factor: int = 10
+
+
+_config = MarlinConfig()
+
+
+def get_config() -> MarlinConfig:
+    return _config
+
+
+def set_config(**kwargs: Any) -> MarlinConfig:
+    for k, v in kwargs.items():
+        if not hasattr(_config, k):
+            raise AttributeError(f"unknown marlin_tpu config key: {k}")
+        setattr(_config, k, v)
+    return _config
+
+
+@contextlib.contextmanager
+def config_context(**kwargs: Any) -> Iterator[MarlinConfig]:
+    old = {k: getattr(_config, k) for k in kwargs}
+    try:
+        set_config(**kwargs)
+        yield _config
+    finally:
+        set_config(**old)

@@ -1,0 +1,10 @@
+from .text import (  # noqa: F401
+    load_matrix_file,
+    load_matrix_files,
+    load_block_matrix_file,
+    load_block_matrix_files,
+    load_coordinate_matrix,
+    load_svm_den_vec_matrix,
+    save_matrix,
+)
+from .checkpoint import save_checkpoint, load_checkpoint, save_sharded, load_sharded  # noqa: F401

@@ -1,0 +1,97 @@
+"""Sharded array checkpointing and training-state checkpoint/resume.
+
+The reference has *no* training-state checkpointing — only text-format matrix
+persistence (SURVEY.md §5.4) — and it inherits fault tolerance from Spark's
+lineage recomputation. SPMD JAX has no lineage, so the rebuild makes
+checkpoint-restart explicit (SURVEY.md §7 hard parts): iterative workloads
+(NN/ALS/LR/PageRank) can save their full state every k steps and resume after
+a failure.
+
+Two layers:
+- :func:`save_sharded` / :func:`load_sharded` — per-shard ``.npy`` files plus a
+  small JSON manifest; each process writes only the shards it owns
+  (multi-host friendly), and loading re-places shards onto the target sharding.
+- :func:`save_checkpoint` / :func:`load_checkpoint` — a pytree-of-arrays
+  training checkpoint with step counter, for the iterative workloads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+__all__ = ["save_sharded", "load_sharded", "save_checkpoint", "load_checkpoint"]
+
+
+def save_sharded(arr: jax.Array, path: str) -> None:
+    """Write one .npy per addressable shard + a JSON manifest."""
+    os.makedirs(path, exist_ok=True)
+    shards = []
+    for shard in arr.addressable_shards:
+        fname = f"shard_{shard.replica_id}_{'_'.join(map(str, [s.start or 0 for s in shard.index]))}.npy"
+        np.save(os.path.join(path, fname), np.asarray(shard.data))
+        shards.append({
+            "file": fname,
+            "index": [[s.start, s.stop] for s in shard.index],
+            "replica_id": shard.replica_id,
+        })
+    manifest = {
+        "shape": list(arr.shape),
+        "dtype": str(arr.dtype),
+        "shards": shards,
+        "process_index": jax.process_index(),
+    }
+    with open(os.path.join(path, f"manifest_{jax.process_index()}.json"), "w") as f:
+        json.dump(manifest, f)
+
+
+def load_sharded(path: str, sharding=None) -> jax.Array:
+    """Assemble the global array from shard files; re-place onto ``sharding``
+    (or leave on the default device)."""
+    manifests = [
+        json.load(open(os.path.join(path, f)))
+        for f in sorted(os.listdir(path))
+        if f.startswith("manifest_")
+    ]
+    if not manifests:
+        raise FileNotFoundError(f"no checkpoint manifests under {path}")
+    shape = tuple(manifests[0]["shape"])
+    dtype = np.dtype(manifests[0]["dtype"])
+    out = np.zeros(shape, dtype)
+    for man in manifests:
+        for sh in man["shards"]:
+            if sh["replica_id"] != 0:
+                continue
+            idx = tuple(slice(a if a is not None else 0, b) for a, b in sh["index"])
+            out[idx] = np.load(os.path.join(path, sh["file"]))
+    arr = jax.numpy.asarray(out)
+    if sharding is not None:
+        arr = jax.device_put(arr, sharding)
+    return arr
+
+
+def save_checkpoint(state, path: str, step: int) -> None:
+    """Save a pytree-of-arrays training state (weights, optimizer moments, …)."""
+    os.makedirs(path, exist_ok=True)
+    leaves, treedef = jax.tree.flatten(state)
+    np.savez(
+        os.path.join(path, f"ckpt_{step:08d}.npz"),
+        **{f"leaf_{i}": np.asarray(jax.device_get(x)) for i, x in enumerate(leaves)},
+    )
+    with open(os.path.join(path, "latest"), "w") as f:
+        f.write(str(step))
+
+
+def load_checkpoint(state_like, path: str, step: int | None = None):
+    """Restore a checkpoint into the structure of ``state_like``.
+    Returns (state, step). ``step=None`` loads the latest."""
+    if step is None:
+        with open(os.path.join(path, "latest")) as f:
+            step = int(f.read().strip())
+    data = np.load(os.path.join(path, f"ckpt_{step:08d}.npz"))
+    leaves, treedef = jax.tree.flatten(state_like)
+    new_leaves = [jax.numpy.asarray(data[f"leaf_{i}"]) for i in range(len(leaves))]
+    return jax.tree.unflatten(treedef, new_leaves), step

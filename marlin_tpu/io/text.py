@@ -1,0 +1,198 @@
+"""Text-format matrix IO, wire-compatible with the reference.
+
+Formats (all parsed with the reference's separator rule ``",\\s?|\\s+"``):
+
+- row format ``rowIdx:v,v,...`` — written by DenseVecMatrix.saveToFileSystem
+  (DenseVecMatrix.scala:1042-1046), read by MTUtils.loadMatrixFile
+  (MTUtils.scala:286-300) and produced by tools/generateMatrix.cpp (our
+  ``tools/genmat.cpp`` emits the same).
+- block format ``blkRow-blkCol-rows-cols:colMajorData`` — BlockMatrix.save
+  (BlockMatrix.scala:538-559), MTUtils.loadBlockMatrixFile (:324-340).
+- COO ``i j v`` / ``i,j,v`` (optional trailing timestamp, MovieLens-style) —
+  MTUtils.loadCoordinateMatrix (:228-243).
+- SVM-ish ``rowIdx idx:val idx:val ...`` with 1-based feature indices —
+  MTUtils.loadSVMDenVecMatrix (:253-276).
+- ``_description`` sidecar with matrix name/size —
+  DenseVecMatrix.saveWithDescription (:1055-1064).
+
+Directory variants mirror the reference's ``wholeTextFiles`` loaders
+(MTUtils.scala:350-392): every regular file in the directory is concatenated.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+
+_SEP = re.compile(r",\s?|\s+")
+
+
+def _check_dims(shape, rows, cols):
+    if rows is not None and cols is not None:
+        return (rows, cols)
+    return shape
+
+
+def _iter_lines(path: str):
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            full = os.path.join(path, name)
+            if os.path.isfile(full) and not name.startswith("_"):
+                with open(full) as f:
+                    yield from f
+    else:
+        with open(path) as f:
+            yield from f
+
+
+def _rows_from_lines(lines):
+    entries = {}
+    ncols = 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        idx_part, vals_part = line.split(":", 1)
+        vals = np.array([float(x) for x in _SEP.split(vals_part.strip()) if x])
+        entries[int(idx_part)] = vals
+        ncols = max(ncols, len(vals))
+    nrows = max(entries) + 1 if entries else 0
+    out = np.zeros((nrows, ncols))
+    for i, v in entries.items():
+        out[i, : len(v)] = v
+    return out
+
+
+def load_matrix_file(path: str, mesh=None):
+    """``rowIdx:v,v,...`` → DenseVecMatrix (MTUtils.loadMatrixFile)."""
+    from ..matrix.dense import DenseVecMatrix
+
+    return DenseVecMatrix.from_array(_rows_from_lines(_iter_lines(path)), mesh)
+
+
+def load_matrix_files(path: str, mesh=None):
+    """Directory variant (MTUtils.loadMatrixFiles, MTUtils.scala:350-368)."""
+    return load_matrix_file(path, mesh)
+
+
+def _blocks_from_lines(lines):
+    blocks = {}
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        head, vals_part = line.split(":", 1)
+        info = head.split("-")
+        br, bc, r, c = (int(x) for x in info[:4])
+        vals = np.array([float(x) for x in _SEP.split(vals_part.strip()) if x])
+        # column-major, like Breeze BDM.create (MTUtils.scala:336-338)
+        blocks[(br, bc)] = vals.reshape((c, r)).T
+    if not blocks:
+        return np.zeros((0, 0))
+    nbr = max(k[0] for k in blocks) + 1
+    nbc = max(k[1] for k in blocks) + 1
+    row_heights = [blocks[(i, 0)].shape[0] for i in range(nbr)]
+    col_widths = [blocks[(0, j)].shape[1] for j in range(nbc)]
+    out = np.zeros((sum(row_heights), sum(col_widths)))
+    r0 = 0
+    for i in range(nbr):
+        c0 = 0
+        for j in range(nbc):
+            b = blocks[(i, j)]
+            out[r0 : r0 + b.shape[0], c0 : c0 + b.shape[1]] = b
+            c0 += b.shape[1]
+        r0 += row_heights[i]
+    return out
+
+
+def load_block_matrix_file(path: str, mesh=None):
+    """Block text format → BlockMatrix (MTUtils.loadBlockMatrixFile)."""
+    from ..matrix.dense import BlockMatrix
+
+    return BlockMatrix.from_array(_blocks_from_lines(_iter_lines(path)), mesh)
+
+
+def load_block_matrix_files(path: str, mesh=None):
+    return load_block_matrix_file(path, mesh)
+
+
+def load_coordinate_matrix(path: str, shape=None, mesh=None):
+    """COO text → CoordinateMatrix (MTUtils.loadCoordinateMatrix). Accepts
+    3-field ``i j v`` / ``i,j,v`` lines and 4-field MovieLens lines whose
+    trailing timestamp is dropped."""
+    from ..matrix.sparse import CoordinateMatrix
+
+    ri, ci, vals = [], [], []
+    for line in _iter_lines(path):
+        line = line.strip()
+        if not line:
+            continue
+        parts = [x for x in _SEP.split(line) if x]
+        if len(parts) not in (3, 4):
+            raise ValueError(f"bad COO line: {line!r}")
+        ri.append(int(parts[0]))
+        ci.append(int(parts[1]))
+        vals.append(float(parts[2]))
+    return CoordinateMatrix(np.array(ri, np.int64), np.array(ci, np.int64),
+                            np.array(vals, np.float32), shape=shape, mesh=mesh)
+
+
+def load_svm_den_vec_matrix(path: str, vector_len: int, mesh=None):
+    """SVM-like rows with 1-based sparse features → dense DenseVecMatrix
+    (MTUtils.loadSVMDenVecMatrix; the head item is the row index, not a label)."""
+    from ..matrix.dense import DenseVecMatrix
+
+    rows = {}
+    for line in _iter_lines(path):
+        line = line.strip()
+        if not line:
+            continue
+        items = line.split(" ")
+        idx = int(items[0])
+        arr = np.zeros(vector_len)
+        for item in items[1:]:
+            i, v = item.split(":")
+            arr[int(i) - 1] = float(v)
+        rows[idx] = arr
+    nrows = max(rows) + 1 if rows else 0
+    out = np.zeros((nrows, vector_len))
+    for i, v in rows.items():
+        out[i] = v
+    return DenseVecMatrix.from_array(out, mesh)
+
+
+def save_matrix(mat, path: str, fmt: str = "text", description: bool = False):
+    """Save in row-text or block-text format (DenseVecMatrix.saveToFileSystem /
+    BlockMatrix.save). ``description=True`` writes the ``_description`` sidecar
+    (DenseVecMatrix.saveWithDescription)."""
+    arr = mat.to_numpy()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if fmt == "text":
+        with open(path, "w") as f:
+            for i in range(arr.shape[0]):
+                f.write(f"{i}:" + ",".join(repr(float(x)) for x in arr[i]) + "\n")
+    elif fmt == "block":
+        # one block per mesh tile, column-major payload
+        from ..matrix.dense import BlockMatrix
+
+        nbr = mat.mesh.shape.get("rows", 1) if isinstance(mat, BlockMatrix) else 1
+        nbc = mat.mesh.shape.get("cols", 1) if isinstance(mat, BlockMatrix) else 1
+        m, n = arr.shape
+        rsz, csz = -(-m // nbr), -(-n // nbc)
+        with open(path, "w") as f:
+            for bi in range(nbr):
+                for bj in range(nbc):
+                    blk = arr[bi * rsz : min((bi + 1) * rsz, m),
+                              bj * csz : min((bj + 1) * csz, n)]
+                    if blk.size == 0:
+                        continue
+                    payload = ",".join(repr(float(x)) for x in blk.T.ravel())
+                    f.write(f"{bi}-{bj}-{blk.shape[0]}-{blk.shape[1]}:{payload}\n")
+    else:
+        raise ValueError(f"unknown save format: {fmt}")
+    if description:
+        with open(os.path.join(os.path.dirname(path) or ".", "_description"), "w") as f:
+            f.write(f"name: {os.path.basename(path)}\n")
+            f.write(f"rows: {arr.shape[0]}\ncols: {arr.shape[1]}\n")

@@ -1,0 +1,237 @@
+"""Blocked dense factorizations on sharded global arrays.
+
+The reference implements block LU / Cholesky / inverse as driver-orchestrated
+panel+trailing-update loops: each iteration filters the pivot block out of the
+RDD, *collects it to the driver*, factorizes it with Breeze there, broadcasts
+the factors back, applies panel updates, and shuffle-multiplies the trailing
+submatrix (DenseVecMatrix.scala:283-466 LU, 475-561 Cholesky, 568-764 inverse).
+The per-iteration driver round-trip is its scalability bottleneck (SURVEY.md §3.3).
+
+TPU-first, the whole factorization is ONE jitted XLA program: a
+``lax.fori_loop`` over block columns where the pivot block is factorized
+*on-device* (``jax.lax.linalg.lu`` / ``jnp.linalg.cholesky`` on a b×b slice —
+the "collect+broadcast" disappears into XLA's implicit data movement), panel
+updates are masked triangular solves over full-width panels (static shapes for
+XLA; masks replace the shrinking trailing extents), and the trailing update is
+a full-size rank-b GEMM with masked operands — zero contribution outside the
+trailing region, so no dynamic shapes anywhere.
+
+Pivoting matches the reference's choice: partial pivoting *within the pivot
+block only* (the reference LUs just the collected pivot block,
+DenseVecMatrix.scala:345-349), with row swaps applied across the full width and
+the global permutation accumulated.
+
+Square inputs are padded with an identity tail so the padded problem stays
+nonsingular; block size comes from the config knobs that mirror
+``marlin.lu.basesize``/``marlin.cholesky.basesize``/``marlin.inverse.basesize``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+from ..config import get_config
+from ..mesh import pad_to_multiple
+
+__all__ = ["lu_decompose", "cholesky_decompose", "inverse"]
+
+
+def _pad_with_identity(a: jax.Array, n_pad: int) -> jax.Array:
+    """Embed the n×n matrix in an n_pad×n_pad one with an identity tail block,
+    so factorizations of the padded matrix restrict to the original."""
+    n = a.shape[0]
+    if n_pad == n:
+        return a
+    out = jnp.zeros((n_pad, n_pad), a.dtype)
+    out = out.at[:n, :n].set(a)
+    pad_diag = jnp.arange(n, n_pad)
+    return out.at[pad_diag, pad_diag].set(jnp.ones((), a.dtype))
+
+
+@functools.partial(jax.jit, static_argnames=("block", "sharding"))
+def _blocked_lu(a: jax.Array, block: int, sharding=None):
+    """Right-looking blocked LU with block-local partial pivoting.
+    Returns (LU-combined, global permutation vector)."""
+    n = a.shape[0]
+    nb = n // block
+    solve = jax.scipy.linalg.solve_triangular
+    perm0 = jnp.arange(n, dtype=jnp.int32)
+    col_idx = jnp.arange(n)
+    row_idx = jnp.arange(n)[:, None]
+
+    def body(i, carry):
+        a, gperm = carry
+        o = i * block
+        piv = jax.lax.dynamic_slice(a, (o, o), (block, block))
+        lu, _, p = jax.lax.linalg.lu(piv)
+        l11 = jnp.tril(lu, -1) + jnp.eye(block, dtype=a.dtype)
+        u11 = jnp.triu(lu)
+
+        # Row panel (rows o:o+b, full width): permute rows, then
+        #   cols <  o      -> permuted L-part unchanged
+        #   o..o+b         -> the combined lu block
+        #   cols >= o+b    -> U12 = L11^{-1} (P A12)
+        rpan = jax.lax.dynamic_slice(a, (o, 0), (block, n))
+        rpan = rpan[p, :]
+        u12 = solve(l11, rpan, lower=True, unit_diagonal=True)
+        in_block = (col_idx[None, :] >= o) & (col_idx[None, :] < o + block)
+        lu_wide = jax.lax.dynamic_update_slice(jnp.zeros_like(rpan), lu, (0, o))
+        rpan_new = jnp.where(
+            col_idx[None, :] < o, rpan, jnp.where(in_block, lu_wide, u12)
+        )
+        a = jax.lax.dynamic_update_slice(a, rpan_new, (o, 0))
+
+        # Column panel (full height, cols o:o+b): rows >= o+b get
+        # L21 = A21 U11^{-1}; rows above keep what's already written.
+        cpan = jax.lax.dynamic_slice(a, (0, o), (n, block))
+        l21 = solve(u11.T, cpan.T, lower=True).T
+        below = row_idx >= o + block
+        cpan_new = jnp.where(below, l21, cpan)
+        a = jax.lax.dynamic_update_slice(a, cpan_new, (0, o))
+
+        # Trailing update with masked operands: zero outside the trailing
+        # region, so the full-size GEMM only touches A22.
+        l21_m = jnp.where(below, l21, jnp.zeros((), a.dtype))
+        u12_m = jnp.where(col_idx[None, :] >= o + block, u12, jnp.zeros((), a.dtype))
+        a = a - jnp.dot(l21_m, u12_m, precision="highest")
+
+        # Accumulate the global permutation.
+        gseg = jax.lax.dynamic_slice(gperm, (o,), (block,))
+        gperm = jax.lax.dynamic_update_slice(gperm, gseg[p], (o,))
+        if sharding is not None:
+            a = jax.lax.with_sharding_constraint(a, sharding)
+        return a, gperm
+
+    return jax.lax.fori_loop(0, nb, body, (a, perm0))
+
+
+@functools.partial(jax.jit, static_argnames=("block", "sharding"))
+def _blocked_cholesky(a: jax.Array, block: int, sharding=None):
+    """Right-looking blocked Cholesky (lower). No pivoting (SPD input)."""
+    n = a.shape[0]
+    nb = n // block
+    solve = jax.scipy.linalg.solve_triangular
+    row_idx = jnp.arange(n)[:, None]
+
+    def body(i, a):
+        o = i * block
+        piv = jax.lax.dynamic_slice(a, (o, o), (block, block))
+        l11 = jnp.linalg.cholesky(piv)
+
+        cpan = jax.lax.dynamic_slice(a, (0, o), (n, block))
+        l21 = solve(l11, cpan.T, lower=True).T
+        below = row_idx >= o + block
+        at_block = (row_idx >= o) & (row_idx < o + block)
+        l11_tall = jax.lax.dynamic_update_slice(jnp.zeros_like(cpan), l11, (o, 0))
+        cpan_new = jnp.where(below, l21, jnp.where(at_block, l11_tall, cpan))
+        a = jax.lax.dynamic_update_slice(a, cpan_new, (0, o))
+
+        l21_m = jnp.where(below, l21, jnp.zeros((), a.dtype))
+        a = a - jnp.dot(l21_m, l21_m.T, precision="highest")
+        # restore the block column (the rank-b update also touched it)
+        a = jax.lax.dynamic_update_slice(a, cpan_new, (0, o))
+        if sharding is not None:
+            a = jax.lax.with_sharding_constraint(a, sharding)
+        return a
+
+    a = jax.lax.fori_loop(0, nb, body, a)
+    return jnp.tril(a)
+
+
+def _require_square(mat):
+    if mat.num_rows() != mat.num_cols():
+        raise ValueError(f"factorization needs a square matrix, got {mat.shape}")
+
+
+def _mode_to_local(mode: str, n: int) -> bool:
+    cfg = get_config()
+    if mode in ("local", "breeze"):  # "breeze" kept as a parity alias
+        return True
+    if mode in ("dist", "distspark"):
+        return False
+    if mode == "auto":  # reference: n > 6000 -> dist (DenseVecMatrix.scala:289-298)
+        return n <= cfg.local_fallback_dim
+    raise ValueError(f"unknown factorization mode: {mode}")
+
+
+def lu_decompose(mat, mode: str = "auto", block_size: int | None = None):
+    """Block LU with partial pivoting (DenseVecMatrix.luDecompose,
+    DenseVecMatrix.scala:283-466). Returns ``(L, U, perm)`` where ``perm`` is
+    the row-permutation vector: ``A[perm] == L @ U``."""
+    _require_square(mat)
+    n = mat.num_rows()
+    a = mat.logical()
+    if _mode_to_local(mode, n):
+        lu, _, p = jax.lax.linalg.lu(a)
+        l = jnp.tril(lu, -1) + jnp.eye(n, dtype=a.dtype)
+        u = jnp.triu(lu)
+        return mat._wrap(l), mat._wrap(u), np.asarray(jax.device_get(p))
+
+    b = block_size or get_config().lu_base_size
+    b = min(b, n)
+    n_pad = pad_to_multiple(n, b)
+    a_pad = _pad_with_identity(a, n_pad)
+    sharding = NamedSharding(mat.mesh, mat.spec) if n_pad % _grid(mat) == 0 else None
+    lu_pad, perm = _blocked_lu(a_pad, b, sharding)
+    lu_log = lu_pad[:n, :n]
+    l = jnp.tril(lu_log, -1) + jnp.eye(n, dtype=a.dtype)
+    u = jnp.triu(lu_log)
+    return mat._wrap(l), mat._wrap(u), np.asarray(jax.device_get(perm[:n]))
+
+
+def _grid(mat) -> int:
+    """LCM-ish divisor check helper: the row-axis shard count of the matrix."""
+    ax = mat.spec[0] if len(mat.spec) > 0 else None
+    return mat.mesh.shape[ax] if ax is not None else 1
+
+
+def cholesky_decompose(mat, mode: str = "auto", block_size: int | None = None):
+    """Block Cholesky, lower factor (DenseVecMatrix.choleskyDecompose,
+    DenseVecMatrix.scala:475-561). Returns L with ``A == L @ Lᵀ``."""
+    _require_square(mat)
+    n = mat.num_rows()
+    a = mat.logical()
+    if _mode_to_local(mode, n):
+        return mat._wrap(jnp.linalg.cholesky(a))
+    b = block_size or get_config().cholesky_base_size
+    b = min(b, n)
+    n_pad = pad_to_multiple(n, b)
+    a_pad = _pad_with_identity(a, n_pad)
+    sharding = NamedSharding(mat.mesh, mat.spec) if n_pad % _grid(mat) == 0 else None
+    l_pad = _blocked_cholesky(a_pad, b, sharding)
+    return mat._wrap(l_pad[:n, :n])
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def _inverse_via_lu(a: jax.Array, block: int):
+    lu_pad, perm = _blocked_lu(a, block)
+    n = a.shape[0]
+    solve = jax.scipy.linalg.solve_triangular
+    l = jnp.tril(lu_pad, -1) + jnp.eye(n, dtype=a.dtype)
+    u = jnp.triu(lu_pad)
+    # A[perm] = L U  =>  A^{-1} = (U^{-1} L^{-1}) P  where P x = x[perm]
+    pa_inv = solve(u, solve(l, jnp.eye(n, dtype=a.dtype), lower=True, unit_diagonal=True))
+    return pa_inv[:, jnp.argsort(perm)][:, :n]  # apply P on the right
+
+
+def inverse(mat, mode: str = "auto", block_size: int | None = None):
+    """Matrix inverse (DenseVecMatrix.inverse, DenseVecMatrix.scala:568-764).
+    The reference runs a blocked Gauss-Jordan-style forward + backward sweep
+    with driver-factorized pivots; here it is blocked LU + two sharded
+    triangular solves in one XLA program."""
+    _require_square(mat)
+    n = mat.num_rows()
+    a = mat.logical()
+    if _mode_to_local(mode, n):
+        return mat._wrap(jnp.linalg.inv(a))
+    b = block_size or get_config().inverse_base_size
+    b = min(b, n)
+    n_pad = pad_to_multiple(n, b)
+    a_pad = _pad_with_identity(a, n_pad)
+    inv_pad = _inverse_via_lu(a_pad, b)
+    return mat._wrap(inv_pad[:n, :n])

@@ -1,0 +1,145 @@
+"""Truncated SVD via Gramian eigendecomposition or matrix-free Lanczos.
+
+The reference's ``computeSVD`` (DenseVecMatrix.scala:1531-1652) auto-selects
+between local LAPACK SVD, local ARPACK eigs of the Gramian, and "dist-eigs":
+ARPACK's reverse-communication Lanczos loop running *on the driver* with each
+``v ↦ AᵀA·v`` evaluated as a distributed aggregate — one full cluster
+round-trip per Lanczos iteration (DenseVecMatrix.scala:1743-1834, SURVEY.md §3).
+
+TPU-first, ARPACK disappears: the Lanczos recurrence itself is a
+``lax.scan`` over a jitted sharded mat-vec, so the *entire* iteration — k
+steps, full reorthogonalization, collectives — is one XLA program with zero
+host round-trips. The small tridiagonal eigenproblem is solved with ``eigh``
+at the end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import get_config
+
+__all__ = ["compute_svd", "lanczos", "SVDResult"]
+
+
+@dataclasses.dataclass
+class SVDResult:
+    """Mirror of the reference's SVD case class (U, s, V)."""
+
+    u: object | None  # DenseVecMatrix | None (None when compute_u=False)
+    s: np.ndarray  # singular values, descending
+    v: np.ndarray  # right singular vectors, (n, k)
+
+
+@functools.partial(jax.jit, static_argnames=("num_iters",))
+def _lanczos_tridiag(a: jax.Array, v0: jax.Array, num_iters: int):
+    """Lanczos with full reorthogonalization on the operator v ↦ Aᵀ(A v).
+    Returns (alphas, betas, Q) of the tridiagonalization."""
+    n = v0.shape[0]
+
+    def matvec(v):
+        return jnp.dot(a.T, jnp.dot(a, v, precision="highest"), precision="highest")
+
+    q0 = v0 / jnp.linalg.norm(v0)
+    qs = jnp.zeros((num_iters + 1, n), v0.dtype).at[0].set(q0)
+
+    def body(carry, i):
+        qs, beta_prev = carry
+        q = qs[i]
+        w = matvec(q)
+        alpha = jnp.dot(w, q)
+        w = w - alpha * q - beta_prev * qs[i - 1] * (i > 0)
+        # full reorthogonalization against all stored vectors (classical
+        # Gram-Schmidt twice is enough at these iteration counts)
+        for _ in range(2):
+            w = w - qs.T @ (qs @ w)
+        beta = jnp.linalg.norm(w)
+        q_next = jnp.where(beta > 1e-12, w / jnp.maximum(beta, 1e-30), jnp.zeros_like(w))
+        qs = qs.at[i + 1].set(q_next)
+        return (qs, beta), (alpha, beta)
+
+    (qs, _), (alphas, betas) = jax.lax.scan(
+        body, (qs, jnp.zeros((), v0.dtype)), jnp.arange(num_iters)
+    )
+    return alphas, betas, qs
+
+
+def lanczos(a: jax.Array, k: int, num_iters: int | None = None, seed: int = 0):
+    """Top-k eigenpairs of AᵀA by Lanczos — the role of ARPACK ``dsaupd``/
+    ``dseupd`` (DenseVecMatrix.symmetricEigs, DenseVecMatrix.scala:1743-1834).
+    Returns (eigenvalues desc, eigenvectors (n, k))."""
+    n = a.shape[1]
+    cfg = get_config()
+    if num_iters is None:
+        num_iters = min(n, max(2 * k + 1, min(n, k * cfg.lanczos_max_iter_factor)))
+    num_iters = min(num_iters, n)
+    v0 = jax.random.normal(jax.random.key(seed), (n,), a.dtype)
+    alphas, betas, qs = _lanczos_tridiag(a, v0, num_iters)
+    t = (
+        jnp.diag(alphas)
+        + jnp.diag(betas[:-1], 1)
+        + jnp.diag(betas[:-1], -1)
+    )
+    evals, evecs = jnp.linalg.eigh(t)
+    # eigh returns ascending; take top k
+    idx = jnp.argsort(-evals)[:k]
+    evals_k = evals[idx]
+    # Ritz vectors: Q[:iters].T @ evecs
+    vecs = qs[:num_iters].T @ evecs[:, idx]
+    vecs = vecs / jnp.maximum(jnp.linalg.norm(vecs, axis=0, keepdims=True), 1e-30)
+    return evals_k, vecs
+
+
+def compute_svd(mat, k: int, mode: str = "auto", compute_u: bool = True,
+                rcond: float = 1e-9, seed: int = 0) -> SVDResult:
+    """Truncated SVD (DenseVecMatrix.computeSVD, DenseVecMatrix.scala:1531-1652).
+
+    Modes, matching the reference's auto-selection (:1569-1588):
+      - "local-svd": full jnp SVD of the gathered matrix (small n and m)
+      - "local-eigs": eigh of the n×n Gramian (small n)
+      - "dist-eigs": matrix-free Lanczos on the sharded array (large n)
+    """
+    m, n = mat.shape
+    if k < 1 or k > n:
+        raise ValueError(f"requested k={k} singular values for n={n}")
+    cfg = get_config()
+    if mode == "auto":
+        if n < 100 or (k > n / 2 and n <= cfg.svd_local_dim):
+            mode = "local-svd" if m <= cfg.svd_local_dim else "local-eigs"
+        elif n <= cfg.svd_local_dim:
+            mode = "local-eigs"
+        else:
+            mode = "dist-eigs"
+
+    a = mat.logical()
+    if mode == "local-svd":
+        u_full, s_full, vt = jnp.linalg.svd(a, full_matrices=False)
+        s, v = s_full[:k], vt[:k].T
+        u = mat._wrap(u_full[:, :k]) if compute_u else None
+        return SVDResult(u, np.asarray(s), np.asarray(v))
+    if mode == "local-eigs":
+        g = jnp.dot(a.T, a, precision="highest")
+        evals, evecs = jnp.linalg.eigh(g)
+        idx = jnp.argsort(-evals)[:k]
+        evals_k, v = evals[idx], evecs[:, idx]
+    elif mode == "dist-eigs":
+        evals_k, v = lanczos(a, k, seed=seed)
+    else:
+        raise ValueError(f"unknown SVD mode: {mode}")
+
+    s = jnp.sqrt(jnp.maximum(evals_k, 0.0))
+    # drop numerically-zero singular values like the reference's sigma
+    # threshold (DenseVecMatrix.scala:1598-1617)
+    keep = int(jnp.sum(s > (s[0] * rcond)))
+    s, v = s[:keep], v[:, :keep]
+    u = None
+    if compute_u:
+        # U = A V Σ^{-1} (DenseVecMatrix.scala:1632-1650)
+        u_arr = jnp.dot(a, v, precision="highest") / jnp.maximum(s, 1e-30)[None, :]
+        u = mat._wrap(u_arr)
+    return SVDResult(u, np.asarray(s), np.asarray(v))

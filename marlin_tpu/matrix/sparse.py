@@ -1,0 +1,183 @@
+"""Sparse distributed matrices.
+
+Reference types: ``SparseVecMatrix`` — row-partitioned sparse rows
+``RDD[(Long, BSV[Double])]`` with an outer-product shuffle multiply
+(matrix/SparseVecMatrix.scala:22-50) — and ``CoordinateMatrix`` — COO entries
+``RDD[((Long, Long), Float)]``, the ALS entry point
+(matrix/CoordinateMatrix.scala).
+
+TPU-first: sparse data is index/value arrays (COO triplets or a BCOO), because
+the MXU wants *dense padded blocks* — so every sparse×dense product routes
+through ``jax.experimental.sparse`` BCOO dot_general (gather + MXU under XLA),
+and sparse×sparse keeps a sparse result like the reference. Entry arrays can be
+sharded 1-D over the mesh; index-space ops (max-reduce for dims, scatter for
+densify) are XLA ops rather than RDD reduces.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+from jax.sharding import Mesh
+
+from ..config import get_config
+from ..mesh import default_mesh
+from ..ops.local import mult_sparse_dense, mult_sparse_sparse
+
+__all__ = ["SparseVecMatrix", "CoordinateMatrix"]
+
+
+class CoordinateMatrix:
+    """COO matrix: parallel (rows, cols, values) arrays
+    (matrix/CoordinateMatrix.scala:14-100)."""
+
+    def __init__(self, row_indices, col_indices, values, shape: tuple[int, int] | None = None,
+                 mesh: Mesh | None = None):
+        self.row_indices = jnp.asarray(row_indices, jnp.int32)
+        self.col_indices = jnp.asarray(col_indices, jnp.int32)
+        self.values = jnp.asarray(values)
+        self.mesh = mesh or default_mesh()
+        if shape is None:
+            # dims via max-index reduce (CoordinateMatrix.scala:67-75)
+            shape = (
+                int(jnp.max(self.row_indices)) + 1,
+                int(jnp.max(self.col_indices)) + 1,
+            )
+        self._shape = (int(shape[0]), int(shape[1]))
+
+    @classmethod
+    def from_entries(cls, entries, shape=None, mesh=None):
+        """Build from an iterable of (i, j, v) triplets."""
+        arr = np.asarray(list(entries))
+        return cls(arr[:, 0].astype(np.int64), arr[:, 1].astype(np.int64),
+                   arr[:, 2], shape=shape, mesh=mesh)
+
+    def num_rows(self) -> int:
+        return self._shape[0]
+
+    def num_cols(self) -> int:
+        return self._shape[1]
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return self._shape
+
+    @property
+    def nnz(self) -> int:
+        return int(self.values.shape[0])
+
+    def to_bcoo(self) -> jsparse.BCOO:
+        idx = jnp.stack([self.row_indices, self.col_indices], axis=1)
+        return jsparse.BCOO((self.values, idx), shape=self._shape)
+
+    def to_dense(self) -> jax.Array:
+        z = jnp.zeros(self._shape, self.values.dtype)
+        return z.at[self.row_indices, self.col_indices].add(self.values)
+
+    def to_dense_vec_matrix(self, mesh: Mesh | None = None):
+        """Densify to a row-sharded matrix (CoordinateMatrix.toDenseVecMatrix,
+        CoordinateMatrix.scala:51-64)."""
+        from .dense import DenseVecMatrix
+
+        return DenseVecMatrix.from_array(self.to_dense(), mesh or self.mesh)
+
+    def to_sparse_vec_matrix(self, mesh: Mesh | None = None) -> "SparseVecMatrix":
+        return SparseVecMatrix(self.to_bcoo(), self._shape, mesh or self.mesh)
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(jax.device_get(self.to_dense()))
+
+    def als(self, rank: int, iterations: int = 10, lam: float = 0.01, seed: int = 0,
+            **kwargs):
+        """Alternating least squares on these ratings (CoordinateMatrix.ALS,
+        CoordinateMatrix.scala:89-98 → ml/ALSHelp.scala)."""
+        from ..ml.als import als_run
+
+        return als_run(self, rank, iterations=iterations, lam=lam, seed=seed, **kwargs)
+
+    def __repr__(self):
+        return f"CoordinateMatrix(shape={self._shape}, nnz={self.nnz})"
+
+
+class SparseVecMatrix:
+    """Sparse matrix held as a BCOO, the analog of the row-partitioned sparse
+    type (matrix/SparseVecMatrix.scala:14-71)."""
+
+    def __init__(self, bcoo: jsparse.BCOO, shape: tuple[int, int] | None = None,
+                 mesh: Mesh | None = None):
+        self.bcoo = bcoo
+        self._shape = tuple(int(s) for s in (shape or bcoo.shape))
+        self.mesh = mesh or default_mesh()
+
+    @classmethod
+    def from_dense(cls, arr, mesh=None):
+        arr = jnp.asarray(arr)
+        return cls(jsparse.BCOO.fromdense(arr), arr.shape, mesh)
+
+    @classmethod
+    def random(cls, seed: int, rows: int, cols: int, density: float = 0.01, mesh=None,
+               dtype=None):
+        """Random sparse matrix (MTUtils.randomSpaVecMatrix → RandomSpaVecRDD,
+        rdd/RandomRDD.scala:136-159)."""
+        dtype = dtype or get_config().default_dtype
+        nnz = max(1, int(rows * cols * density))
+        key = jax.random.key(seed)
+        k1, k2, k3 = jax.random.split(key, 3)
+        ri = jax.random.randint(k1, (nnz,), 0, rows, dtype=jnp.int32)
+        ci = jax.random.randint(k2, (nnz,), 0, cols, dtype=jnp.int32)
+        vals = jax.random.uniform(k3, (nnz,), dtype=dtype)
+        idx = jnp.stack([ri, ci], axis=1)
+        bcoo = jsparse.BCOO((vals, idx), shape=(rows, cols)).sum_duplicates()
+        return cls(bcoo, (rows, cols), mesh)
+
+    def num_rows(self) -> int:
+        return self._shape[0]
+
+    def num_cols(self) -> int:
+        return self._shape[1]
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def nnz(self) -> int:
+        return int(self.bcoo.nse)
+
+    def multiply_sparse(self, other: "SparseVecMatrix") -> CoordinateMatrix:
+        """Sparse × sparse with sparse (COO) result — the role of the
+        outer-product shuffle multiply (SparseVecMatrix.multiplySparse,
+        SparseVecMatrix.scala:22-50), as one XLA sparse contraction."""
+        out = mult_sparse_sparse(self.bcoo, other.bcoo)
+        out = out.sum_duplicates()
+        return CoordinateMatrix(out.indices[:, 0], out.indices[:, 1], out.data,
+                                shape=(self.num_rows(), other.num_cols()), mesh=self.mesh)
+
+    def multiply(self, other):
+        """Sparse × dense → dense distributed matrix."""
+        from .dense import BlockMatrix, DenseMatrix
+
+        if isinstance(other, SparseVecMatrix):
+            return self.multiply_sparse(other)
+        dense = other.logical() if isinstance(other, DenseMatrix) else jnp.asarray(other)
+        out = mult_sparse_dense(self.bcoo, dense)
+        return BlockMatrix.from_array(out, self.mesh)
+
+    def to_dense_vec_matrix(self, mesh: Mesh | None = None):
+        """Densify (SparseVecMatrix.toDenseVecMatrix, SparseVecMatrix.scala:56-65)."""
+        from .dense import DenseVecMatrix
+
+        return DenseVecMatrix.from_array(self.bcoo.todense(), mesh or self.mesh)
+
+    def to_coordinate_matrix(self) -> CoordinateMatrix:
+        b = self.bcoo.sum_duplicates()
+        return CoordinateMatrix(b.indices[:, 0], b.indices[:, 1], b.data,
+                                shape=self._shape, mesh=self.mesh)
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(jax.device_get(self.bcoo.todense()))
+
+    def __repr__(self):
+        return f"SparseVecMatrix(shape={self._shape}, nnz={self.nnz})"

@@ -1,0 +1,112 @@
+"""Device-mesh runtime for marlin_tpu.
+
+The reference delegates all distribution to Spark: a driver builds an RDD DAG
+and Spark schedules shuffle/broadcast over executors (SURVEY.md §0, §2.8). The
+TPU-native equivalent is a static SPMD design: arrays carry a
+``jax.sharding.NamedSharding`` over a 2-D device ``Mesh`` and XLA inserts ICI/DCN
+collectives. This module owns mesh construction, the process-level distributed
+bring-up (the analog of ``new SparkContext``, examples/MatrixMultiply.scala:37),
+and small sharding helpers used across the library.
+
+Mesh axes are named ``"rows"`` and ``"cols"``: a row-partitioned matrix (the
+reference's ``DenseVecMatrix``, matrix/DenseVecMatrix.scala:41-44) is sharded
+``P("rows", None)``; a 2-D block-partitioned matrix (``BlockMatrix``,
+matrix/BlockMatrix.scala:28) is sharded ``P("rows", "cols")``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROWS = "rows"
+COLS = "cols"
+
+_default_mesh: Mesh | None = None
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kwargs,
+) -> None:
+    """Multi-host bring-up. Replaces Spark's driver/executor process management
+    (the reference's L0, SURVEY.md §1): on a multi-host TPU slice each host calls
+    this once before building meshes; single-host callers may skip it entirely.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+
+
+def best_grid(n_devices: int) -> tuple[int, int]:
+    """Factor ``n_devices`` into the most square (rows, cols) grid, preferring
+    rows >= cols. This is the default 2-D layout; the CARMA heuristic
+    (parallel/carma.py) overrides it per-multiply when shapes are skewed."""
+    best = (n_devices, 1)
+    for r in range(1, int(math.isqrt(n_devices)) + 1):
+        if n_devices % r == 0:
+            best = (n_devices // r, r)
+    return best
+
+
+def create_mesh(
+    shape: Sequence[int] | None = None,
+    axis_names: Sequence[str] = (ROWS, COLS),
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Create a mesh over the given (or all) devices.
+
+    ``shape=None`` picks a near-square 2-D grid over all devices. Pass
+    ``shape=(n, 1)`` for a purely row-sharded ("DenseVecMatrix-like") layout.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if shape is None:
+        shape = best_grid(len(devs))
+    size = int(np.prod(shape))
+    if size > len(devs):
+        raise ValueError(f"mesh shape {tuple(shape)} needs {size} devices, have {len(devs)}")
+    arr = np.array(devs[:size]).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def default_mesh() -> Mesh:
+    """The process-global mesh (lazily built over all devices). The analog of the
+    single shared SparkContext every reference example threads through its API."""
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = create_mesh()
+    return _default_mesh
+
+
+def set_default_mesh(mesh: Mesh | None) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(ROWS, None))
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(ROWS, COLS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def mesh_shape(mesh: Mesh) -> tuple[int, int]:
+    return mesh.shape[ROWS], mesh.shape[COLS]
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m

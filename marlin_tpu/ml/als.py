@@ -1,0 +1,101 @@
+"""Alternating least squares on explicit ratings.
+
+The reference ports the old MLlib blocked ALS (ml/ALSHelp.scala): user/product
+factor blocks with in/out link tables, a message-passing shuffle per half-
+iteration (outlinks → messages → join inlinks, ALSHelp.scala:263-286), per-user
+normal equations accumulated with BLAS dspr (:236-254), solved via an explicit
+``inv(AᵀA)`` (:388-392 — a numerical weakness SURVEY.md §7 flags to fix).
+
+TPU-first there are no link tables and no shuffles: factors are dense sharded
+(num_users × rank) / (num_items × rank) arrays; for each half-step the rated
+items' factors are *gathered* by index (XLA turns cross-shard gathers into
+collectives), per-rating outer products ``v vᵀ`` are accumulated per user with
+``segment_sum`` (the dspr loop, vectorized), and the per-user rank×rank normal
+equations are solved batched with ``jnp.linalg.solve`` — not an explicit
+inverse. One whole ALS sweep is a single jitted program.
+
+Supports the regularization modes of the reference: plain λ and
+weighted-λ (``alpha``-free explicit ALS-WR scaling by each user's rating count,
+ALSHelp.scala:57-60 implicitPrefs=false path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["als_run", "ALSModel"]
+
+
+@dataclasses.dataclass
+class ALSModel:
+    user_features: object  # DenseVecMatrix (num_users × rank)
+    product_features: object  # DenseVecMatrix (num_items × rank)
+
+    def predict(self, users, items) -> jax.Array:
+        u = self.user_features.logical()
+        v = self.product_features.logical()
+        return jnp.sum(u[jnp.asarray(users)] * v[jnp.asarray(items)], axis=1)
+
+    def rmse(self, coo) -> float:
+        pred = self.predict(coo.row_indices, coo.col_indices)
+        err = pred - coo.values
+        return float(jnp.sqrt(jnp.mean(err * err)))
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "weighted"))
+def _solve_side(factors_other, seg_ids, other_ids, ratings, rank, lam,
+                num_segments, weighted):
+    """One half-step: recompute `num_segments` factor rows from the fixed other
+    side. seg_ids: which row each rating belongs to; other_ids: which fixed
+    factor it references."""
+    vt = factors_other[other_ids]  # (nnz, rank) gathered
+    # per-rating normal-equation contributions (the vectorized dspr loop,
+    # ALSHelp.scala:292-382)
+    outer = vt[:, :, None] * vt[:, None, :]  # (nnz, rank, rank)
+    xtx = jax.ops.segment_sum(outer, seg_ids, num_segments)
+    xty = jax.ops.segment_sum(vt * ratings[:, None], seg_ids, num_segments)
+    counts = jax.ops.segment_sum(jnp.ones_like(ratings), seg_ids, num_segments)
+    reg = lam * (counts[:, None] if weighted else jnp.ones_like(counts)[:, None])
+    eye = jnp.eye(xtx.shape[-1], dtype=xtx.dtype)
+    a = xtx + reg[:, :, None] * eye
+    # rows with no ratings keep a well-posed system (identity) and get 0
+    b = xty
+    sol = jnp.linalg.solve(a, b[..., None])[..., 0]
+    return jnp.where(counts[:, None] > 0, sol, jnp.zeros_like(sol))
+
+
+def als_run(ratings, rank: int, iterations: int = 10, lam: float = 0.01,
+            seed: int = 0, weighted_lambda: bool = True, mesh=None) -> ALSModel:
+    """Run blocked ALS (ALSHelp.ALSRun, ml/ALSHelp.scala:34-96).
+
+    ``ratings`` is a CoordinateMatrix of (user, product, rating). Factors are
+    initialized on the unit sphere like ``randomFactor`` (ALSHelp.scala:170-179).
+    """
+    from ..matrix.dense import DenseVecMatrix
+
+    mesh = mesh or ratings.mesh
+    num_users, num_items = ratings.shape
+    users = jnp.asarray(ratings.row_indices, jnp.int32)
+    items = jnp.asarray(ratings.col_indices, jnp.int32)
+    vals = jnp.asarray(ratings.values, jnp.float32)
+
+    key_u, key_v = jax.random.split(jax.random.key(seed))
+    u = jax.random.normal(key_u, (num_users, rank), jnp.float32)
+    u = jnp.abs(u) / jnp.linalg.norm(u, axis=1, keepdims=True)
+    v = jax.random.normal(key_v, (num_items, rank), jnp.float32)
+    v = jnp.abs(v) / jnp.linalg.norm(v, axis=1, keepdims=True)
+
+    for _ in range(iterations):
+        # products fixed -> update users, then users fixed -> update products
+        u = _solve_side(v, users, items, vals, rank, lam, num_users, weighted_lambda)
+        v = _solve_side(u, items, users, vals, rank, lam, num_items, weighted_lambda)
+
+    return ALSModel(
+        DenseVecMatrix.from_array(u, mesh),
+        DenseVecMatrix.from_array(v, mesh),
+    )

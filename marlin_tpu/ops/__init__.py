@@ -1,0 +1,9 @@
+from .local import (  # noqa: F401
+    gemm,
+    matvec,
+    dspr,
+    syrk,
+    mult_sparse_dense,
+    mult_dense_sparse,
+    mult_sparse_sparse,
+)

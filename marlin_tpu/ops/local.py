@@ -1,0 +1,98 @@
+"""Single-device block math kernels (the reference's L2, SURVEY.md §2.2).
+
+The reference's per-block hot path is Breeze ``BDM * BDM`` → netlib dgemm
+(matrix/SubMatrix.scala:87-105), hand-rolled mixed sparse/dense kernels
+(matrix/LibMatrixMult.scala:15-77), CSC×CSC sparse-sparse multiply
+(matrix/Matrices.scala:129-152) and a BLAS ``dspr`` rank-1 update
+(matrix/DenseVecMatrix.scala:1691-1722). On TPU every dense contraction lowers
+to the MXU via XLA ``dot_general``; the sparse kernels use ``jax.experimental
+.sparse`` BCOO (densifying the *output*, which is dense in all reference uses).
+
+There is no SubMatrix-style dense/sparse tagged union here: JAX arrays and BCOO
+arrays are dispatched by type in :func:`block_multiply`, the direct analog of
+``SubMatrix.multiply``'s four-way dispatch table.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..config import get_config
+
+
+def _precision(precision: str | None):
+    return precision or get_config().matmul_precision
+
+
+def gemm(a: jax.Array, b: jax.Array, precision: str | None = None) -> jax.Array:
+    """Dense block GEMM: the dgemm reached through Breeze ``BDM * BDM`` in the
+    reference (SubMatrix.scala:92). Accumulates in float32 on the MXU."""
+    return jnp.dot(
+        a, b, precision=_precision(precision), preferred_element_type=a.dtype
+    )
+
+
+def matvec(a: jax.Array, x: jax.Array, precision: str | None = None) -> jax.Array:
+    """Dense mat-vec (SubMatrix.multiply(Vector), SubMatrix.scala:131-139)."""
+    return jnp.dot(a, x, precision=_precision(precision))
+
+
+def dspr(alpha: float, x: jax.Array, a: jax.Array) -> jax.Array:
+    """Symmetric rank-1 update ``A += alpha * x xᵀ`` on a *full* (not packed)
+    matrix. The reference calls BLAS dspr on a packed upper-triangular buffer
+    (DenseVecMatrix.scala:1691-1703); packed storage buys nothing on TPU, so we
+    keep full storage and let the MXU do the outer product."""
+    return a + alpha * jnp.outer(x, x)
+
+
+def syrk(a: jax.Array, precision: str | None = None) -> jax.Array:
+    """Gramian block ``AᵀA`` (the per-partition step of
+    DenseVecMatrix.computeGramianMatrix, DenseVecMatrix.scala:1444-1486)."""
+    return jnp.dot(a.T, a, precision=_precision(precision))
+
+
+def _to_bcoo(x) -> jsparse.BCOO:
+    if isinstance(x, jsparse.BCOO):
+        return x
+    return jsparse.BCOO.fromdense(x)
+
+
+def mult_sparse_dense(sp, dense: jax.Array) -> jax.Array:
+    """Sparse × dense block multiply with dense output — the role of
+    ``LibMatrixMult.multSparseDense`` (LibMatrixMult.scala:43-77). The
+    reference's 32×32 cache blocking is a CPU-cache trick; on TPU the BCOO
+    dot_general lowers to gather + MXU work under XLA."""
+    return _to_bcoo(sp) @ dense
+
+
+def mult_dense_sparse(dense: jax.Array, sp) -> jax.Array:
+    """Dense × sparse block multiply (``LibMatrixMult.multDenseSparse``,
+    LibMatrixMult.scala:15-41)."""
+    return (_to_bcoo(sp).T @ dense.T).T
+
+
+def mult_sparse_sparse(a, b) -> jsparse.BCOO:
+    """Sparse × sparse multiply with sparse output (CSC×CSC in the reference,
+    Matrices.scala:129-152)."""
+    out = jsparse.bcoo_dot_general(
+        _to_bcoo(a), _to_bcoo(b), dimension_numbers=(((1,), (0,)), ((), ()))
+    )
+    return out
+
+
+def block_multiply(a: Any, b: Any, precision: str | None = None):
+    """Four-way dense/sparse dispatch, the analog of ``SubMatrix.multiply``
+    (SubMatrix.scala:87-105)."""
+    a_sp = isinstance(a, jsparse.BCOO)
+    b_sp = isinstance(b, jsparse.BCOO)
+    if a_sp and b_sp:
+        return mult_sparse_sparse(a, b)
+    if a_sp:
+        return mult_sparse_dense(a, b)
+    if b_sp:
+        return mult_dense_sparse(a, b)
+    return gemm(a, b, precision)

@@ -1,0 +1,2 @@
+from .carma import split_method, dim_to_split  # noqa: F401
+from .matmul import matmul, rmm_matmul, broadcast_matmul, gspmd_matmul  # noqa: F401

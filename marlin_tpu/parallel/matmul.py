@@ -1,0 +1,229 @@
+"""Distributed matrix multiply strategies.
+
+This is the TPU-native replacement for the reference's flagship path — the
+replication matrix multiply ("RMM") and its adaptive dispatch:
+
+- ``BlockMatrix.multiply`` replicates A-blocks n×, B-blocks m×, routes each
+  (i, j, l) pair to its own shuffle partition via ``BlockID.seq`` +
+  ``MatrixMultPartitioner``, joins, GEMMs per pair, then reduces over k
+  (matrix/BlockMatrix.scala:149-220, rdd/MatrixMultPartitioner.scala:6-33).
+- ``DenseVecMatrix.multiply(other, cores, broadcastThreshold)`` picks between a
+  broadcast multiply for small operands and a CARMA-split shuffle multiply
+  (matrix/DenseVecMatrix.scala:196-231).
+
+Here the same three strategies exist, but as *static SPMD programs* instead of
+dynamic shuffles:
+
+- :func:`rmm_matmul` — the (m, k, n) task grid becomes a 3-D device mesh
+  ``("m", "k", "n")``; "replicate A n times" is simply A's sharding being
+  replicated along the ``n`` axis (zero-copy on ICI until XLA decides to move
+  bytes), the per-pair GEMM is the per-device ``jnp.dot``, and ``reduceByKey``
+  over k is ``lax.psum`` over the ``k`` axis.
+- :func:`broadcast_matmul` — the small operand gets a fully-replicated
+  sharding (the analog of ``sc.broadcast``, DenseVecMatrix.scala:1660-1680).
+- :func:`gspmd_matmul` — hands the sharded contraction to XLA's SPMD
+  partitioner, which chooses the collective schedule itself; this is the
+  "RMMv2 vs RMMv3" competition (examples/RMMcompare.scala:13-16) resolved by
+  the compiler per shape.
+
+All functions take/return *logical* (unpadded) arrays; shard-divisibility
+padding happens inside the jitted program and is sliced off before returning.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import get_config
+from ..mesh import default_mesh, pad_to_multiple
+from .carma import split_method
+
+_M, _K, _N = "m", "k", "n"
+
+
+def _resolve_precision(precision):
+    return precision or get_config().matmul_precision
+
+
+def build_rmm_mesh(split: tuple[int, int, int], devices=None) -> Mesh:
+    """Arrange devices into the (m_split, k_split, n_split) grid chosen by the
+    CARMA heuristic — the mesh-shaped descendant of ``MatrixMultPartitioner``'s
+    m·k·n partition space."""
+    devs = list(devices) if devices is not None else jax.devices()
+    pm, pk, pn = split
+    need = pm * pk * pn
+    if need > len(devs):
+        raise ValueError(f"split {split} needs {need} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:need]).reshape(pm, pk, pn), (_M, _K, _N))
+
+
+@functools.lru_cache(maxsize=64)
+def _rmm_fn(mesh3: Mesh, precision: str, accum_dtype):
+    def local(ab, bb):
+        c = jnp.dot(ab, bb, precision=precision, preferred_element_type=accum_dtype)
+        return jax.lax.psum(c, _K)
+
+    @jax.jit
+    def f(a, b):
+        return jax.shard_map(
+            local,
+            mesh=mesh3,
+            in_specs=(P(_M, _K), P(_K, _N)),
+            out_specs=P(_M, _N),
+        )(a, b)
+
+    return f
+
+
+def rmm_matmul(
+    a: jax.Array,
+    b: jax.Array,
+    split: tuple[int, int, int] | None = None,
+    devices=None,
+    precision: str | None = None,
+    accum_dtype=None,
+) -> jax.Array:
+    """3-D replicated matmul over an (m, k, n) device mesh.
+
+    ``split=None`` runs the CARMA heuristic over the actual shapes and device
+    count (the ``multiply(other, cores)`` auto path, DenseVecMatrix.scala:214-218);
+    an explicit split mirrors ``multiply(other, (m, k, n))``
+    (DenseVecMatrix.scala:109-141).
+    """
+    m, k = a.shape
+    k2, n = b.shape
+    if k != k2:
+        raise ValueError(f"inner dimensions mismatch: {a.shape} @ {b.shape}")
+    devs = list(devices) if devices is not None else jax.devices()
+    if split is None:
+        split = split_method(m, k, n, len(devs))
+    mesh3 = build_rmm_mesh(split, devs)
+    pm, pk, pn = split
+    mp, kp, np_ = pad_to_multiple(m, pm), pad_to_multiple(k, pk), pad_to_multiple(n, pn)
+    if (mp, kp) != (m, k):
+        a = jnp.pad(a, ((0, mp - m), (0, kp - k)))
+    if (kp, np_) != (k, n):
+        b = jnp.pad(b, ((0, kp - k), (0, np_ - n)))
+    # place operands on the 3-D mesh (may be a device subset when the CARMA
+    # split doesn't fill the device count); shard_map then runs collective-free
+    # along m/n and psums along k.
+    a = jax.device_put(a, NamedSharding(mesh3, P(_M, _K)))
+    b = jax.device_put(b, NamedSharding(mesh3, P(_K, _N)))
+    fn = _rmm_fn(mesh3, _resolve_precision(precision), accum_dtype or a.dtype)
+    c = fn(a, b)
+    return c[:m, :n] if (mp, np_) != (m, n) else c
+
+
+@functools.lru_cache(maxsize=64)
+def _broadcast_fn(out_sharding, replicate_which: str, precision: str, accum_dtype):
+    repl = NamedSharding(out_sharding.mesh, P())
+
+    @jax.jit
+    def f(a, b):
+        if replicate_which == "b":
+            b_ = jax.lax.with_sharding_constraint(b, repl)
+            c = jnp.dot(a, b_, precision=precision, preferred_element_type=accum_dtype)
+        else:
+            a_ = jax.lax.with_sharding_constraint(a, repl)
+            c = jnp.dot(a_, b, precision=precision, preferred_element_type=accum_dtype)
+        return jax.lax.with_sharding_constraint(c, out_sharding)
+
+    return f
+
+
+def broadcast_matmul(
+    a: jax.Array,
+    b: jax.Array,
+    out_sharding: NamedSharding,
+    replicate: str = "b",
+    precision: str | None = None,
+    accum_dtype=None,
+) -> jax.Array:
+    """Small-operand multiply: fully replicate one side (the analog of
+    collect-to-driver + ``sc.broadcast``, DenseVecMatrix.scala:196-207 and
+    1660-1680; BlockMatrix.scala:280-335) and keep the big side sharded. No
+    inter-device communication happens on the big operand at all."""
+    fn = _broadcast_fn(
+        out_sharding, replicate, _resolve_precision(precision), accum_dtype or a.dtype
+    )
+    return fn(a, b)
+
+
+@functools.lru_cache(maxsize=64)
+def _gspmd_fn(out_sharding, precision: str, accum_dtype):
+    @jax.jit
+    def f(a, b):
+        c = jnp.dot(a, b, precision=precision, preferred_element_type=accum_dtype)
+        return jax.lax.with_sharding_constraint(c, out_sharding)
+
+    return f
+
+
+def gspmd_matmul(
+    a: jax.Array,
+    b: jax.Array,
+    out_sharding: NamedSharding,
+    precision: str | None = None,
+    accum_dtype=None,
+) -> jax.Array:
+    """Sharded contraction scheduled by XLA's SPMD partitioner: the inputs keep
+    whatever shardings they carry and the compiler inserts the collective
+    schedule. Competes with :func:`rmm_matmul` in examples/rmm_compare."""
+    fn = _gspmd_fn(out_sharding, _resolve_precision(precision), accum_dtype or a.dtype)
+    return fn(a, b)
+
+
+def _size_mb(x: jax.Array) -> float:
+    return x.size * x.dtype.itemsize / 1e6
+
+
+def matmul(
+    a: jax.Array,
+    b: jax.Array,
+    out_sharding: NamedSharding | None = None,
+    strategy: str = "auto",
+    split: tuple[int, int, int] | None = None,
+    broadcast_threshold_mb: float | None = None,
+    precision: str | None = None,
+    accum_dtype=None,
+) -> jax.Array:
+    """Adaptive distributed matmul — the dispatch logic of
+    ``DenseVecMatrix.multiply(other, cores, broadcastThreshold)``
+    (DenseVecMatrix.scala:196-231): broadcast when one operand is small,
+    otherwise CARMA-split RMM over the mesh.
+    """
+    cfg = get_config()
+    threshold = (
+        broadcast_threshold_mb
+        if broadcast_threshold_mb is not None
+        else cfg.broadcast_threshold_mb
+    )
+    if out_sharding is None:
+        mesh = default_mesh()
+        out_sharding = NamedSharding(mesh, P(mesh.axis_names[0], mesh.axis_names[1]))
+
+    if strategy == "auto":
+        if _size_mb(b) <= threshold:
+            strategy = "broadcast"
+        elif _size_mb(a) <= threshold:
+            strategy = "broadcast_a"
+        else:
+            strategy = "rmm"
+
+    if strategy == "broadcast":
+        return broadcast_matmul(a, b, out_sharding, "b", precision, accum_dtype)
+    if strategy == "broadcast_a":
+        return broadcast_matmul(a, b, out_sharding, "a", precision, accum_dtype)
+    if strategy == "rmm":
+        # the caller re-places the logical result onto its own sharding
+        return rmm_matmul(
+            a, b, split, list(out_sharding.mesh.devices.flat), precision, accum_dtype
+        )
+    if strategy == "gspmd":
+        return gspmd_matmul(a, b, out_sharding, precision, accum_dtype)
+    raise ValueError(f"unknown matmul strategy: {strategy}")

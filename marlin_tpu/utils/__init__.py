@@ -1,0 +1,14 @@
+from .profiling import timer, evaluate, StepTimer, trace  # noqa: F401
+from .mtutils import (  # noqa: F401
+    random_den_vec_matrix,
+    random_block_matrix,
+    random_dis_vector,
+    random_spa_vec_matrix,
+    zeros_den_vec_matrix,
+    ones_den_vec_matrix,
+    ones_dis_vector,
+    array_to_matrix,
+    matrix_to_array,
+    repeat_by_row,
+    repeat_by_column,
+)

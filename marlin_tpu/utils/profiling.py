@@ -1,0 +1,80 @@
+"""Timing and profiling hooks.
+
+The reference's only observability is ad-hoc ``System.currentTimeMillis`` deltas
+in examples and factorization loops plus ``MTUtils.evaluate`` to force lazy RDDs
+(SURVEY.md §5.1 calls this a gap worth exceeding). Here:
+
+- :func:`evaluate` — force-materialize (block_until_ready) without transferring,
+  the analog of ``MTUtils.evaluate`` (utils/MTUtils.scala:218-220). Essential
+  for honest timing under JAX's async dispatch.
+- :func:`timer` — wall-clock context manager that prints millis like the
+  examples do (e.g. examples/BLAS3.scala:34-56).
+- :class:`StepTimer` — per-iteration timing hook for training loops.
+- :func:`trace` — context manager around ``jax.profiler`` emitting a TensorBoard
+  trace (XLA-level, per-op on TPU); no reference equivalent.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+def evaluate(*xs):
+    """Block until the given arrays (or matrices) are materialized on device;
+    returns them. Accepts marlin matrices, jax arrays, or pytrees."""
+    for x in xs:
+        data = getattr(x, "data", x)
+        jax.block_until_ready(data)
+    return xs[0] if len(xs) == 1 else xs
+
+
+@contextlib.contextmanager
+def timer(label: str = "", results: list | None = None, quiet: bool = False):
+    t0 = time.perf_counter()
+    yield
+    dt_ms = (time.perf_counter() - t0) * 1000.0
+    if results is not None:
+        results.append(dt_ms)
+    if not quiet:
+        print(f"{label or 'elapsed'}: {dt_ms:.1f} ms")
+
+
+class StepTimer:
+    """Records per-step wall-clock; use around the body of an iterative loop."""
+
+    def __init__(self):
+        self.times_ms: list[float] = []
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self, sync=None):
+        if sync is not None:
+            evaluate(sync)
+        self.times_ms.append((time.perf_counter() - self._t0) * 1000.0)
+
+    @property
+    def mean_ms(self) -> float:
+        return sum(self.times_ms) / max(1, len(self.times_ms))
+
+    def summary(self) -> str:
+        if not self.times_ms:
+            return "no steps recorded"
+        return (
+            f"{len(self.times_ms)} steps, mean {self.mean_ms:.1f} ms, "
+            f"min {min(self.times_ms):.1f} ms, max {max(self.times_ms):.1f} ms"
+        )
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/marlin_tpu_trace"):
+    """Emit a jax.profiler trace viewable in TensorBoard/XProf."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()

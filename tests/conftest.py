@@ -1,0 +1,55 @@
+"""Shared fixtures: the "fake cluster" meshes and golden 4×4 matrices.
+
+Mirrors the reference's test harness (SURVEY.md §4): ``LocalSparkContext``
+(a local[2] SparkContext) becomes an 8-device CPU mesh; the fixed 4×4 matrix
+from ``DistributedMatrixSuite`` (src/test/.../DistributedMatrixSuite.scala:15-32)
+becomes NumPy goldens compared via ``to_numpy()`` against a NumPy oracle
+(their pattern: compute distributed, ``toBreeze()``, compare vs Breeze).
+"""
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    """2-D (2×4) mesh — the BlockMatrix grid."""
+    return mt.create_mesh((2, 4))
+
+
+@pytest.fixture(scope="session")
+def row_mesh():
+    """1-D (8×1) mesh — the DenseVecMatrix row layout."""
+    return mt.create_mesh((8, 1))
+
+
+@pytest.fixture()
+def a4():
+    # deliberately non-symmetric, non-singular
+    return np.array(
+        [
+            [1.0, 2.0, 3.0, 4.0],
+            [5.0, 6.0, 7.0, 8.0],
+            [9.0, 10.0, 11.0, 13.0],
+            [14.0, 15.0, 17.0, 16.0],
+        ]
+    )
+
+
+@pytest.fixture()
+def b4():
+    return np.array(
+        [
+            [1.0, 1.0, 2.0, 0.0],
+            [0.0, 3.0, 1.0, 1.0],
+            [2.0, 0.0, 0.0, 1.0],
+            [1.0, 1.0, 1.0, 2.0],
+        ]
+    )
+
+
+def assert_close(mat, expected, tol=1e-4):
+    np.testing.assert_allclose(np.asarray(mat.to_numpy() if hasattr(mat, "to_numpy") else mat),
+                               expected, rtol=tol, atol=tol)

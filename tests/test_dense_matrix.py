@@ -1,0 +1,207 @@
+"""Distributed dense-matrix integration tests — the DistributedMatrixSuite
+analog (src/test/.../DistributedMatrixSuite.scala, 22 tests on a fixed 4×4
+matrix over local[2]): compute distributed on the 8-device CPU mesh, collect
+with to_numpy(), compare against a NumPy oracle."""
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+from tests.conftest import assert_close
+
+
+def test_sizes(mesh, a4):
+    m = mt.DenseVecMatrix.from_array(a4, mesh)
+    assert m.num_rows() == 4 and m.num_cols() == 4
+    b = mt.BlockMatrix.from_array(a4, mesh)
+    assert b.shape == (4, 4)
+    assert b.blocks_by_row == 2 and b.blocks_by_col == 4
+
+
+def test_roundtrip_collect(mesh, a4):
+    # toBreeze analog (DistributedMatrixSuite: transformation tests :86-119)
+    assert_close(mt.DenseVecMatrix.from_array(a4, mesh), a4)
+    assert_close(mt.BlockMatrix.from_array(a4, mesh), a4)
+
+
+def test_uneven_shapes(mesh):
+    # shapes not divisible by the mesh grid exercise the pad-and-mask path
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((7, 5)).astype(np.float32)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    assert m.data.shape != m.shape  # padded
+    assert_close(m, a)
+
+
+def test_conversions(mesh, a4):
+    dv = mt.DenseVecMatrix.from_array(a4, mesh)
+    bm = dv.to_block_matrix()
+    assert isinstance(bm, mt.BlockMatrix)
+    assert_close(bm, a4)
+    back = bm.to_dense_vec_matrix()
+    assert isinstance(back, mt.DenseVecMatrix)
+    assert_close(back, a4)
+
+
+def test_elementwise_ops(mesh, a4, b4):
+    ma = mt.BlockMatrix.from_array(a4, mesh)
+    mb = mt.BlockMatrix.from_array(b4, mesh)
+    assert_close(ma.add(mb), a4 + b4)
+    assert_close(ma.subtract(mb), a4 - b4)
+    assert_close(ma.add(2.0), a4 + 2.0)
+    assert_close(ma.subtract(1.5), a4 - 1.5)
+    assert_close(ma.subtract_by(1.5), 1.5 - a4)
+    assert_close(ma.multiply(3.0), a4 * 3.0)
+    assert_close(ma.divide(2.0), a4 / 2.0)
+    assert_close(ma.divide_by(2.0), 2.0 / a4, tol=1e-3)
+    assert_close(ma.divide(mb.add(1.0)), a4 / (b4 + 1.0), tol=1e-3)
+    assert_close(ma.dot_product(mb), a4 * b4)
+
+
+def test_elementwise_mixed_layout(mesh, a4, b4):
+    # DenseVec + Block mixed operand alignment
+    ma = mt.DenseVecMatrix.from_array(a4, mesh)
+    mb = mt.BlockMatrix.from_array(b4, mesh)
+    assert_close(ma.add(mb), a4 + b4)
+
+
+def test_scalar_ops_keep_pad_invariant(mesh):
+    a = np.ones((5, 3), np.float32)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    out = m.add(7.0)
+    # pad region must remain zero so sums stay correct
+    assert float(out.sum()) == pytest.approx(5 * 3 * 8.0)
+
+
+def test_multiply_strategies(mesh, a4, b4):
+    expected = a4 @ b4
+    ma = mt.DenseVecMatrix.from_array(a4, mesh)
+    mb = mt.DenseVecMatrix.from_array(b4, mesh)
+    for strategy in ("auto", "broadcast", "rmm", "gspmd"):
+        out = ma.multiply(mb, strategy=strategy)
+        assert isinstance(out, mt.BlockMatrix)
+        assert_close(out, expected)
+
+
+def test_multiply_explicit_splits(mesh, a4, b4):
+    # explicit (m, k, n) splits incl. k=1 (DistributedMatrixSuite :236-249)
+    expected = a4 @ b4
+    ma = mt.BlockMatrix.from_array(a4, mesh)
+    mb = mt.BlockMatrix.from_array(b4, mesh)
+    for split in [(1, 1, 1), (2, 1, 2), (2, 2, 2), (1, 4, 1), (4, 1, 2)]:
+        assert_close(ma.multiply(mb, strategy="rmm", split=split), expected)
+
+
+def test_multiply_rectangular(mesh):
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((13, 7)).astype(np.float32)
+    b = rng.standard_normal((7, 11)).astype(np.float32)
+    ma = mt.BlockMatrix.from_array(a, mesh)
+    for strategy in ("broadcast", "rmm", "gspmd"):
+        assert_close(ma.multiply(mt.BlockMatrix.from_array(b, mesh), strategy=strategy),
+                     a @ b, tol=1e-3)
+
+
+def test_multiply_local_operand(mesh, a4, b4):
+    # local-matrix operand (DistributedMatrixSuite :251-267)
+    ma = mt.DenseVecMatrix.from_array(a4, mesh)
+    assert_close(ma.multiply(b4), a4 @ b4)
+
+
+def test_multiply_dimension_mismatch(mesh, a4):
+    ma = mt.DenseVecMatrix.from_array(a4, mesh)
+    with pytest.raises(ValueError):
+        ma.multiply(np.ones((5, 2)))
+
+
+def test_matvec(mesh, a4):
+    ma = mt.DenseVecMatrix.from_array(a4, mesh)
+    v = np.array([1.0, -1.0, 2.0, 0.5], np.float32)
+    out = ma.multiply(v)
+    np.testing.assert_allclose(out.to_numpy(), a4 @ v, rtol=1e-4)
+
+
+def test_transpose(mesh, a4):
+    # :302-316
+    assert_close(mt.BlockMatrix.from_array(a4, mesh).transpose(), a4.T)
+    rng = np.random.default_rng(2)
+    r = rng.standard_normal((6, 9)).astype(np.float32)
+    assert_close(mt.DenseVecMatrix.from_array(r, mesh).transpose(), r.T)
+
+
+def test_sum_and_dot(mesh, a4, b4):
+    # :319-338
+    ma = mt.BlockMatrix.from_array(a4, mesh)
+    assert float(ma.sum()) == pytest.approx(a4.sum())
+    assert_close(ma.dot_product(mt.BlockMatrix.from_array(b4, mesh)), a4 * b4)
+
+
+def test_inverse_permutation_matrix(mesh):
+    # inverse on a permutation matrix (:340-352)
+    p = np.eye(4)[[2, 0, 3, 1]].astype(np.float32)
+    m = mt.BlockMatrix.from_array(p, mesh)
+    assert_close(m.inverse(), np.linalg.inv(p), tol=1e-4)
+
+
+def test_cbind(mesh, a4, b4):
+    assert_close(mt.DenseVecMatrix.from_array(a4, mesh).c_bind(
+        mt.DenseVecMatrix.from_array(b4, mesh)), np.concatenate([a4, b4], axis=1))
+
+
+def test_slicing(mesh, a4):
+    # :207-223, inclusive ranges
+    m = mt.DenseVecMatrix.from_array(a4, mesh)
+    assert_close(m.slice_by_row(1, 2), a4[1:3])
+    assert_close(m.slice_by_column(0, 2), a4[:, 0:3])
+    assert_close(m.get_sub_matrix(1, 3, 1, 2), a4[1:4, 1:3])
+    with pytest.raises(ValueError):
+        m.slice_by_row(3, 4)
+
+
+def test_repeat(mesh, a4):
+    # :354-374
+    m = mt.DenseVecMatrix.from_array(a4, mesh)
+    assert_close(m.repeat_by_row(2), np.tile(a4, (1, 2)))
+    assert_close(m.repeat_by_column(3), np.tile(a4, (3, 1)))
+
+
+def test_norms(mesh):
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((9, 5)).astype(np.float32)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    assert float(m.norm("1")) == pytest.approx(np.abs(a).sum(axis=0).max(), rel=1e-4)
+    assert float(m.norm("inf")) == pytest.approx(np.abs(a).sum(axis=1).max(), rel=1e-4)
+    assert float(m.norm("fro")) == pytest.approx(np.linalg.norm(a), rel=1e-4)
+    assert float(m.norm("2")) == pytest.approx(np.linalg.norm(a, 2), rel=1e-3)
+
+
+def test_gramian(mesh):
+    rng = np.random.default_rng(4)
+    a = rng.standard_normal((20, 6)).astype(np.float32)
+    m = mt.DenseVecMatrix.from_array(a, mesh)
+    assert_close(m.gramian(), a.T @ a, tol=1e-3)
+
+
+def test_random_factories_deterministic(mesh):
+    m1 = mt.DenseVecMatrix.random(42, 12, 6, mesh=mesh)
+    m2 = mt.DenseVecMatrix.random(42, 12, 6, mesh=mesh)
+    np.testing.assert_array_equal(m1.to_numpy(), m2.to_numpy())
+    assert not np.allclose(m1.to_numpy(), mt.DenseVecMatrix.random(43, 12, 6, mesh=mesh).to_numpy())
+    z = mt.BlockMatrix.zeros(5, 5, mesh=mesh)
+    assert float(z.sum()) == 0.0
+    o = mt.BlockMatrix.ones(5, 5, mesh=mesh)
+    assert float(o.sum()) == 25.0
+
+
+def test_lr_converges(mesh):
+    # logistic SGD sanity (DenseVecMatrix.lr): separable data
+    rng = np.random.default_rng(5)
+    n = 200
+    x = rng.standard_normal((n, 2)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    data = np.concatenate([y[:, None], x], axis=1)
+    m = mt.DenseVecMatrix.from_array(data, mesh)
+    w = m.lr(step_size=100.0, iters=50)
+    pred = 1.0 / (1.0 + np.exp(-(np.concatenate([np.ones((n, 1)), x], 1) @ w)))
+    acc = ((pred > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.9

@@ -1,0 +1,55 @@
+"""ML workload tests: ALS (untested in the reference — SURVEY.md §4), plus the
+CARMA split heuristic properties."""
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+from marlin_tpu.parallel.carma import near_square_split, split_method
+
+
+def test_als_reduces_rmse(mesh):
+    rng = np.random.default_rng(0)
+    n_users, n_items, rank = 30, 20, 4
+    u_true = rng.standard_normal((n_users, rank)).astype(np.float32)
+    v_true = rng.standard_normal((n_items, rank)).astype(np.float32)
+    full = u_true @ v_true.T
+    # observe 50% of entries
+    mask = rng.random((n_users, n_items)) < 0.5
+    ui, ii = np.nonzero(mask)
+    coo = mt.CoordinateMatrix(ui, ii, full[mask], shape=(n_users, n_items), mesh=mesh)
+    model = coo.als(rank=rank, iterations=12, lam=0.05)
+    rmse = model.rmse(coo)
+    assert rmse < 0.3, f"ALS failed to fit: rmse={rmse}"
+    assert model.user_features.shape == (n_users, rank)
+    assert model.product_features.shape == (n_items, rank)
+
+
+def test_als_predict_shape(mesh):
+    coo = mt.CoordinateMatrix.from_entries(
+        [(0, 0, 5.0), (0, 1, 3.0), (1, 0, 4.0), (2, 1, 1.0)], mesh=mesh
+    )
+    model = coo.als(rank=2, iterations=5, lam=0.1)
+    preds = model.predict([0, 1], [0, 0])
+    assert preds.shape == (2,)
+
+
+def test_carma_split_budget():
+    for m, k, n, p in [(100, 100, 100, 8), (10000, 100, 100, 8), (64, 4096, 64, 16)]:
+        ms, ks, ns = split_method(m, k, n, p)
+        assert ms * ks * ns <= p
+        assert ms >= 1 and ks >= 1 and ns >= 1
+
+
+def test_carma_prefers_long_dim():
+    # k is dominant -> k gets the splits (contraction-parallel, psum over k)
+    ms, ks, ns = split_method(64, 65536, 64, 8)
+    assert ks == 8 and ms == 1 and ns == 1
+    # m dominant -> row-parallel, collective-free
+    ms, ks, ns = split_method(65536, 64, 64, 8)
+    assert ms == 8
+
+
+def test_near_square_split():
+    assert near_square_split(9) == 3
+    assert near_square_split(1) >= 1

@@ -1,0 +1,67 @@
+"""Sparse matrix tests (sparse multiply, DistributedMatrixSuite :152-162, and
+the SparseMultiply example's mode combinations)."""
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+
+
+def _sp(mesh, seed=0, shape=(12, 10), density=0.2):
+    rng = np.random.default_rng(seed)
+    dense = rng.standard_normal(shape).astype(np.float32)
+    dense[rng.random(shape) > density] = 0.0
+    return mt.SparseVecMatrix.from_dense(dense, mesh), dense
+
+
+def test_sparse_roundtrip(mesh):
+    sp, dense = _sp(mesh)
+    np.testing.assert_allclose(sp.to_numpy(), dense)
+    assert sp.shape == dense.shape
+    assert sp.nnz == (dense != 0).sum()
+
+
+def test_sparse_times_dense(mesh):
+    sp, dense = _sp(mesh, 1)
+    rng = np.random.default_rng(2)
+    b = rng.standard_normal((10, 6)).astype(np.float32)
+    out = sp.multiply(mt.BlockMatrix.from_array(b, mesh))
+    assert isinstance(out, mt.BlockMatrix)
+    np.testing.assert_allclose(out.to_numpy(), dense @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_times_sparse(mesh):
+    spa, da = _sp(mesh, 3, (8, 12))
+    spb, db = _sp(mesh, 4, (12, 7))
+    out = spa.multiply_sparse(spb)
+    assert isinstance(out, mt.CoordinateMatrix)
+    np.testing.assert_allclose(out.to_numpy(), da @ db, rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_to_dense_vec(mesh):
+    sp, dense = _sp(mesh, 5)
+    dv = sp.to_dense_vec_matrix()
+    assert isinstance(dv, mt.DenseVecMatrix)
+    np.testing.assert_allclose(dv.to_numpy(), dense)
+
+
+def test_coordinate_matrix(mesh):
+    entries = [(0, 0, 1.0), (1, 2, 2.5), (3, 1, -1.0)]
+    coo = mt.CoordinateMatrix.from_entries(entries, mesh=mesh)
+    assert coo.shape == (4, 3)
+    assert coo.nnz == 3
+    expected = np.zeros((4, 3), np.float32)
+    for i, j, v in entries:
+        expected[i, j] = v
+    np.testing.assert_allclose(coo.to_numpy(), expected)
+    np.testing.assert_allclose(coo.to_dense_vec_matrix().to_numpy(), expected)
+    back = coo.to_sparse_vec_matrix().to_coordinate_matrix()
+    np.testing.assert_allclose(back.to_numpy(), expected)
+
+
+def test_random_sparse(mesh):
+    sp = mt.SparseVecMatrix.random(0, 50, 40, density=0.05, mesh=mesh)
+    arr = sp.to_numpy()
+    assert arr.shape == (50, 40)
+    nnz_frac = (arr != 0).mean()
+    assert 0.01 < nnz_frac < 0.1

@@ -1,0 +1,68 @@
+"""DistributedVector tests (BLAS1 inner/outer and re-chunking,
+DistributedMatrixSuite :121-144, :390-407)."""
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+
+
+def test_inner_product(mesh):
+    x = np.arange(10, dtype=np.float32)
+    y = np.ones(10, dtype=np.float32)
+    vx = mt.DistributedVector.from_array(x, mesh, column_major=False)  # row vector
+    vy = mt.DistributedVector.from_array(y, mesh, column_major=True)
+    assert float(vx.multiply(vy)) == pytest.approx(x @ y)
+
+
+def test_outer_product(mesh):
+    x = np.arange(4, dtype=np.float32)
+    y = np.arange(3, dtype=np.float32) + 1
+    vx = mt.DistributedVector.from_array(x, mesh, column_major=True)
+    vy = mt.DistributedVector.from_array(y, mesh, column_major=False)
+    out = vx.multiply(vy)
+    assert isinstance(out, mt.BlockMatrix)
+    np.testing.assert_allclose(out.to_numpy(), np.outer(x, y))
+
+
+def test_orientation_checks(mesh):
+    v = mt.DistributedVector.from_array(np.ones(4, np.float32), mesh)
+    with pytest.raises(ValueError):
+        v.multiply(v)  # col × col
+    assert float(v.transpose().multiply(v)) == pytest.approx(4.0)
+
+
+def test_arithmetic_and_padding(mesh):
+    x = np.arange(13, dtype=np.float32)  # not divisible by 8 -> padded
+    v = mt.DistributedVector.from_array(x, mesh)
+    assert v._padded
+    np.testing.assert_allclose(v.to_numpy(), x)
+    np.testing.assert_allclose(v.add(v).to_numpy(), 2 * x)
+    np.testing.assert_allclose(v.substract(np.ones(13, np.float32)).to_numpy(), x - 1)
+    np.testing.assert_allclose(v.scale(3.0).to_numpy(), 3 * x)
+    assert float(v.sum()) == pytest.approx(x.sum())
+
+
+def test_random_and_transpose_flag(mesh):
+    v = mt.DistributedVector.random(7, 20, mesh=mesh)
+    assert v.length == 20 and v.column_major
+    vt = v.transpose()
+    assert not vt.column_major
+    np.testing.assert_array_equal(v.to_numpy(), vt.to_numpy())
+
+
+def test_int_vector(mesh):
+    v = mt.DistributedIntVector.from_array(np.array([1, 2, 3]), mesh)
+    assert v.dtype == np.int32
+    assert float(v.sum()) == 6
+
+
+def test_matvec_through_matrix(mesh):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((9, 5)).astype(np.float32)
+    x = rng.standard_normal(5).astype(np.float32)
+    m = mt.DenseVecMatrix.from_array(a, mesh)
+    v = mt.DistributedVector.from_array(x, mesh)
+    out = m.multiply(v)
+    assert isinstance(out, mt.DistributedVector)
+    np.testing.assert_allclose(out.to_numpy(), a @ x, rtol=1e-4, atol=1e-4)

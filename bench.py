@@ -1,0 +1,91 @@
+"""Headline benchmark: dense N×N distributed matmul GFLOP/s on TPU vs the
+CPU-BLAS baseline (the reference's netlib-java dgemm analog — BASELINE.md
+configs; north star = dense multiply beating the CPU baseline on GFLOP/s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GFLOP/s", "vs_baseline": N}
+Extra detail goes to stderr.
+
+Timing notes: device dispatch is async and (under the axon relay) a sync
+round-trip costs tens of ms, so the measurement enqueues REPS multiplies
+back-to-back and forces completion once with a scalar fetch — the same
+discipline MTUtils.evaluate exists for in the reference (MTUtils.scala:218-220).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N = int(os.environ.get("MARLIN_BENCH_N", "4000"))  # BASELINE config 2
+REPS = int(os.environ.get("MARLIN_BENCH_REPS", "30"))
+PRECISION = os.environ.get("MARLIN_BENCH_PRECISION", "high")  # f32-class accuracy
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def cpu_baseline_gflops() -> float:
+    """NumPy (OpenBLAS) float64 GEMM — the netlib-java-BLAS-on-CPU baseline the
+    reference's README compares against (README.md:29)."""
+    n = min(N, 2000)  # keep the CPU run bounded; GFLOP/s is ~size-invariant here
+    a = np.random.default_rng(0).random((n, n))
+    b = np.random.default_rng(1).random((n, n))
+    a @ b  # warm-up
+    t0 = time.perf_counter()
+    a @ b
+    dt = time.perf_counter() - t0
+    return 2 * n**3 / dt / 1e9
+
+
+def tpu_gflops() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    import marlin_tpu as mt
+
+    log(f"devices: {jax.devices()}")
+    mesh = mt.create_mesh()
+    a = mt.DenseVecMatrix.random(0, N, N, mesh=mesh)
+    b = mt.DenseVecMatrix.random(1, N, N, mesh=mesh)
+    float(jnp.sum(a.data) + jnp.sum(b.data))  # materialize inputs
+
+    c = a.multiply(b, precision=PRECISION)  # compile
+    float(jnp.sum(c.data))
+    # correctness anchor vs f64 numpy on a slice
+    rows = np.asarray(c.data[:8]).astype(np.float64)
+    ref = a.to_numpy()[:8].astype(np.float64) @ b.to_numpy().astype(np.float64)
+    rel_err = np.abs(rows[:, :N] - ref).max() / np.abs(ref).max()
+    log(f"matmul rel err vs f64 numpy (precision={PRECISION}): {rel_err:.2e}")
+
+    # enqueue REPS multiplies, force completion once with a scalar fetch
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        c = a.multiply(b, precision=PRECISION)
+    float(jnp.sum(c.data))
+    dt = (time.perf_counter() - t0) / REPS
+    log(f"N={N}: {dt * 1e3:.2f} ms/multiply over {REPS} reps (precision={PRECISION})")
+    return 2 * N**3 / dt / 1e9
+
+
+def main():
+    baseline = cpu_baseline_gflops()
+    log(f"CPU f64 BLAS baseline: {baseline:.1f} GFLOP/s")
+    value = tpu_gflops()
+    print(
+        json.dumps(
+            {
+                "metric": f"dense_matmul_{N}x{N}_gflops",
+                "value": round(value, 1),
+                "unit": "GFLOP/s",
+                "vs_baseline": round(value / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Shared CLI plumbing for the example programs.
+
+The reference's examples are spark-submit ``main()``s that double as the
+benchmark harness — each prints wall-clock millis (SURVEY.md §2.5). These CLIs
+keep the same positional-argument contracts and the same timing prints, minus
+the SparkContext boilerplate: device/mesh bring-up replaces ``new
+SparkContext(conf)`` (e.g. examples/MatrixMultiply.scala:37).
+
+Run as modules from the repo root, e.g.::
+
+    python -m examples.matrix_multiply 4000 4000 4000 8
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def millis() -> float:
+    return time.perf_counter() * 1000.0
+
+
+def die(usage: str):
+    print(usage, file=sys.stderr)
+    raise SystemExit(1)

@@ -1,0 +1,58 @@
+"""Adaptive dense matrix multiply — the flagship benchmark.
+
+Parity with examples/MatrixMultiply.scala: args
+``<A rows> <A cols/B rows> <B cols> <parallelism> [broadcast threshold MB]``;
+two random dense matrices, adaptive multiply (broadcast vs CARMA-split RMM),
+wall-clock printed. The Kryo registrator (:53-59) has no analog — sharded
+arrays need no serializer registration.
+
+Optionally pass ``--files a.txt b.txt`` to load the operands from row-text
+files instead (BASELINE.md config 1 uses data/a.100.100 · data/b.100.100).
+"""
+
+import sys
+
+from examples._common import die, millis
+
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    files = None
+    if "--files" in argv:
+        i = argv.index("--files")
+        files = argv[i + 1 : i + 3]
+        if len(files) != 2:
+            die("--files needs two paths: --files A.txt B.txt")
+        del argv[i : i + 3]
+    if len(argv) < 4 and files is None:
+        die(
+            "usage: matrix_multiply <A rows> <A cols/B rows> <B cols> <parallelism>"
+            " [broadcast threshold MB]\n   or: matrix_multiply --files A.txt B.txt"
+        )
+
+    import marlin_tpu as mt
+
+    mesh = mt.create_mesh()
+    if files:
+        a = mt.load_matrix_file(files[0], mesh)
+        b = mt.load_matrix_file(files[1], mesh)
+    else:
+        m, k, n = int(argv[0]), int(argv[1]), int(argv[2])
+        a = mt.DenseVecMatrix.random(0, m, k, mesh=mesh)
+        b = mt.DenseVecMatrix.random(1, k, n, mesh=mesh)
+    threshold = float(argv[4]) if len(argv) > 4 else None
+    mt.evaluate(a, b)
+
+    t0 = millis()
+    c = a.multiply(b, broadcast_threshold_mb=threshold)
+    mt.evaluate(c)
+    dt = millis() - t0
+    flops = 2.0 * a.num_rows() * a.num_cols() * c.num_cols()
+    print(f"used time {dt:.1f} millis, result blocks: {c.elements_count()}")
+    print(f"effective {flops / dt / 1e6:.1f} GFLOP/s")
+    return c
+
+
+if __name__ == "__main__":
+    main()

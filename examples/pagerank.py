@@ -1,0 +1,50 @@
+"""PageRank over an edge-list file (examples/PageRank.scala: args
+``<file> <iterations> [link num]``; file lines are ``src dst`` pairs; without a
+file, a random graph of ``link num`` nodes is used)."""
+
+import os
+import sys
+
+import numpy as np
+
+from examples._common import die, millis
+
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 1:
+        die("usage: pagerank <edge file | 'random'> [iterations] [node count]")
+    source = argv[0]
+    iterations = int(argv[1]) if len(argv) > 1 else 20
+    n = int(argv[2]) if len(argv) > 2 else 8
+
+    import marlin_tpu as mt
+    from marlin_tpu.ml import build_transition_matrix, pagerank
+
+    mesh = mt.create_mesh()
+    if source != "random":
+        if not os.path.exists(source):
+            die(f"edge file not found: {source} (pass 'random' for a generated graph)")
+        edges = []
+        with open(source) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2:
+                    edges.append((int(parts[0]), int(parts[1])))
+    else:
+        rng = np.random.default_rng(0)
+        edges = [(int(s), int(d)) for s, d in rng.integers(0, n, (4 * n, 2)) if s != d]
+    m = build_transition_matrix(edges)
+    link = mt.BlockMatrix.from_array(m, mesh)
+
+    t0 = millis()
+    ranks = pagerank(link, iterations=iterations)
+    print(f"used time {millis() - t0:.1f} millis")
+    top = np.argsort(-ranks)[:10]
+    for i in top:
+        print(f"node {i}: {ranks[i]:.6f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Multiply-strategy comparison (examples/RMMcompare.scala: args
+``<A rows> <A cols> <B cols> <mode> [m k n]``; the reference compares RMM
+variants, with only "RMMv2" live — :13-16, :39-58). Here all live strategies
+compete: explicit-split RMM (shard_map + psum), GSPMD (XLA-scheduled
+collectives), and broadcast; each is timed and the winner reported."""
+
+import sys
+
+from examples._common import die, millis
+
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 3:
+        die("usage: rmm_compare <A rows> <A cols> <B cols> [mode: rmm|gspmd|broadcast|all] [m k n]")
+    rows, k, cols = (int(x) for x in argv[:3])
+    mode = argv[3] if len(argv) > 3 else "all"
+    split = tuple(int(x) for x in argv[4:7]) if len(argv) >= 7 else None
+
+    import marlin_tpu as mt
+
+    mesh = mt.create_mesh()
+    a = mt.BlockMatrix.random(0, rows, k, mesh=mesh)
+    b = mt.BlockMatrix.random(1, k, cols, mesh=mesh)
+    mt.evaluate(a, b)
+
+    strategies = ["rmm", "gspmd", "broadcast"] if mode == "all" else [mode]
+    timings = {}
+    for strategy in strategies:
+        kwargs = {"split": split} if strategy == "rmm" else {}
+        mt.evaluate(a.multiply(b, strategy=strategy, **kwargs))  # compile
+        t0 = millis()
+        c = mt.evaluate(a.multiply(b, strategy=strategy, **kwargs))
+        timings[strategy] = millis() - t0
+        print(f"{strategy}: {timings[strategy]:.1f} millis")
+    best = min(timings, key=timings.get)
+    print(f"fastest: {best} ({timings[best]:.1f} millis)")
+    return timings
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""MNIST loading for the NeuralNetwork workload.
+
+The reference's loader (examples/NeuralNetwork.scala:32-84) reads MNIST from
+HDFS text with a two-pass partition-size collection, then re-blocks into a
+BlockMatrix plus co-partitioned label chunks. Here: read the standard idx
+(ubyte, optionally gzipped) files directly into one sharded data matrix and an
+int label vector — same sharding, so data/label co-location (the reference's
+NeuralNetworkPartitioner) holds by construction. A synthetic fallback generates
+a classifiable dataset when no files are available.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["load_mnist_images", "load_mnist_labels", "synthetic_mnist"]
+
+
+def _open(path: str):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+def load_mnist_images(path: str) -> np.ndarray:
+    """idx3-ubyte images → (n, 784) float32 in [0, 1]."""
+    with _open(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad idx3 magic {magic}")
+        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+    return (data.reshape(n, rows * cols) / 255.0).astype(np.float32)
+
+
+def load_mnist_labels(path: str) -> np.ndarray:
+    """idx1-ubyte labels → (n,) int32."""
+    with _open(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad idx1 magic {magic}")
+        return np.frombuffer(f.read(n), np.uint8).astype(np.int32)
+
+
+def synthetic_mnist(n: int = 4096, dim: int = 784, classes: int = 10,
+                    seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Classifiable stand-in: class-dependent means + noise."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    centers = rng.standard_normal((classes, dim)).astype(np.float32)
+    x = centers[labels] + 0.5 * rng.standard_normal((n, dim)).astype(np.float32)
+    return ((x - x.min()) / (x.max() - x.min())).astype(np.float32), labels
+
+
+def load_or_synthesize(images_path: str | None, labels_path: str | None,
+                       n_synthetic: int = 4096):
+    """Load real MNIST when paths are given; synthesize only when *no* images
+    path was requested. A given-but-missing or partial path is an error — never
+    silently substitute synthetic data for what the user asked for."""
+    if images_path is None:
+        return synthetic_mnist(n_synthetic)
+    if labels_path is None:
+        raise ValueError("images path given without a labels path")
+    for p in (images_path, labels_path):
+        if not os.path.exists(p):
+            raise FileNotFoundError(f"MNIST file not found: {p}")
+    return load_mnist_images(images_path), load_mnist_labels(labels_path)

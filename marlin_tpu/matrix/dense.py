@@ -411,13 +411,11 @@ class DenseMatrix(DistributedMatrix):
         — parity with DenseVecMatrix.lr (DenseVecMatrix.scala:1005-1035): the
         first column is the label and is replaced by a 1-intercept; the
         per-iteration ``reduce`` of gradients becomes a sharded ``sum`` whose
-        all-reduce XLA schedules over ICI."""
-        m, n = self._shape
-        data = self.logical()
-        labels = data[:, 0]
-        feats = jnp.concatenate([jnp.ones((m, 1), data.dtype), data[:, 1:]], axis=1)
-        w = _lr_train(feats, labels, float(step_size), int(iters), int(m))
-        return np.asarray(jax.device_get(w))
+        all-reduce XLA schedules over ICI. Delegates to the shared jitted loop
+        in marlin_tpu.ml.logistic_regression."""
+        from ..ml.logistic_regression import logistic_regression
+
+        return logistic_regression(self, step_size=step_size, iterations=iters).weights
 
     # ----------------------------------------------------------------- io/print
     def save_to_file_system(self, path: str, fmt: str = "text"):
@@ -489,16 +487,3 @@ def _power_iteration_norm2(a):
     return jnp.linalg.norm(jnp.dot(a, v, precision="highest"))
 
 
-@jax.jit
-def _lr_step(w, feats, labels, scale):
-    margin = -(feats @ w)
-    mul = 1.0 / (1.0 + jnp.exp(margin)) - labels
-    grad = feats.T @ mul
-    return w - grad * scale
-
-
-def _lr_train(feats, labels, step_size, iters, data_size):
-    w = jnp.zeros((feats.shape[1],), feats.dtype)
-    for i in range(1, iters + 1):
-        w = _lr_step(w, feats, labels, step_size / data_size / math.sqrt(i))
-    return w

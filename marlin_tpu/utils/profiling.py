@@ -24,10 +24,20 @@ import jax
 
 def evaluate(*xs):
     """Block until the given arrays (or matrices) are materialized on device;
-    returns them. Accepts marlin matrices, jax arrays, or pytrees."""
+    returns them. Accepts marlin matrices, jax arrays, or pytrees.
+
+    Beyond ``block_until_ready`` this fetches one scalar element per array:
+    some remote-attached runtimes (e.g. relayed TPU tunnels) acknowledge
+    ``block_until_ready`` before execution finishes, and the data-dependent
+    4-byte fetch forces a true completion barrier — without it, timing loops
+    measure dispatch latency instead of compute."""
     for x in xs:
         data = getattr(x, "data", x)
-        jax.block_until_ready(data)
+        for leaf in jax.tree.leaves(data):
+            jax.block_until_ready(leaf)
+            if hasattr(leaf, "ndim") and getattr(leaf, "size", 0) > 0:
+                # 4-byte data-dependent fetch of one element (no relayout)
+                jax.device_get(leaf[(0,) * leaf.ndim])
     return xs[0] if len(xs) == 1 else xs
 
 

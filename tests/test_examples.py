@@ -1,0 +1,147 @@
+"""Smoke tests for the example CLIs (the reference's examples are its manual
+integration suite — SURVEY.md §4; here they run in-process on the CPU mesh)
+and the C++ genmat tool."""
+
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+
+
+def test_matrix_multiply_cli(capsys):
+    from examples.matrix_multiply import main
+
+    main(["64", "48", "32", "8"])
+    out = capsys.readouterr().out
+    assert "used time" in out and "GFLOP/s" in out
+
+
+def test_matrix_multiply_cli_files(tmp_path, capsys, mesh):
+    a = np.random.default_rng(0).random((12, 12)).astype(np.float32)
+    b = np.random.default_rng(1).random((12, 12)).astype(np.float32)
+    pa, pb = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    mt.DenseVecMatrix.from_array(a, mesh).save_to_file_system(pa)
+    mt.DenseVecMatrix.from_array(b, mesh).save_to_file_system(pb)
+    from examples.matrix_multiply import main
+
+    c = main(["--files", pa, pb])
+    np.testing.assert_allclose(c.to_numpy(), a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_blas1_cli(capsys):
+    from examples.blas1 import main
+
+    for mode in ("local", "dist"):
+        main([mode, "100", "4"])
+    assert "inner product" in capsys.readouterr().out
+
+
+def test_blas3_cli(capsys):
+    from examples.blas3 import main
+
+    for mode_args in (["32", "32", "32", "1"], ["32", "32", "32", "2"],
+                      ["32", "32", "32", "3", "2", "2", "2"]):
+        main(mode_args)
+    out = capsys.readouterr().out
+    assert "local multiply" in out and "broadcast multiply" in out and "rmm multiply" in out
+
+
+def test_rmm_compare_cli(capsys):
+    from examples.rmm_compare import main
+
+    timings = main(["48", "48", "48", "all"])
+    assert set(timings) == {"rmm", "gspmd", "broadcast"}
+    assert "fastest:" in capsys.readouterr().out
+
+
+def test_sparse_multiply_cli(capsys):
+    from examples.sparse_multiply import main
+
+    for mode in "123456":
+        main(["32", "32", "32", "0.1", mode])
+    out = capsys.readouterr().out
+    assert "millis" in out
+
+
+def test_lu_example_cli(tmp_path, capsys, mesh):
+    n = 12
+    a = np.random.default_rng(0).random((n, n)).astype(np.float32) + n * np.eye(n, dtype=np.float32)
+    p = str(tmp_path / "a.txt")
+    mt.DenseVecMatrix.from_array(a, mesh).save_to_file_system(p)
+    from examples.lu_decompose import main
+
+    main([p, str(n), str(n), str(tmp_path / "out")])
+    out = capsys.readouterr().out
+    assert "LU used" in out
+    l = mt.load_matrix_file(str(tmp_path / "out.L"), mesh).to_numpy()
+    u = mt.load_matrix_file(str(tmp_path / "out.U"), mesh).to_numpy()
+    perm = [int(x) for x in open(str(tmp_path / "out.perm")).read().split(",")]
+    np.testing.assert_allclose(a[perm], l @ u, rtol=1e-3, atol=1e-3)
+
+
+def test_lr_cli(capsys):
+    from examples.logistic_regression import main
+
+    main(["50", "10.0", "500", "10"])
+    out = capsys.readouterr().out
+    assert "accuracy" in out
+
+
+def test_pagerank_cli(tmp_path, capsys):
+    p = tmp_path / "edges.txt"
+    p.write_text("0 1\n1 2\n2 0\n2 1\n")
+    from examples.pagerank import main
+
+    main([str(p), "30"])
+    out = capsys.readouterr().out
+    assert "node" in out
+
+
+def test_als_cli(tmp_path, capsys):
+    rng = np.random.default_rng(0)
+    lines = [f"{u} {i} {rng.random() * 5:.3f}" for u in range(20) for i in range(10)
+             if rng.random() < 0.5]
+    p = tmp_path / "ratings.txt"
+    p.write_text("\n".join(lines))
+    from examples.als import main
+
+    main([str(p), "3", "5", "0.1"])
+    out = capsys.readouterr().out
+    assert "RMSE" in out
+
+
+def test_nn_cli(capsys):
+    from examples.neural_network import main
+
+    main(["synthetic", "-", "30", "16", "1.0", "128"])
+    out = capsys.readouterr().out
+    assert "train accuracy" in out
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_genmat_tool(tmp_path, mesh):
+    import os
+
+    build = subprocess.run(
+        ["make", "-C", os.path.join(os.path.dirname(__file__), "..", "tools")],
+        capture_output=True, text=True,
+    )
+    assert build.returncode == 0, build.stderr
+    exe = os.path.join(os.path.dirname(__file__), "..", "tools", "genmat")
+    out = subprocess.run([exe, "5", "4", "7"], capture_output=True, text=True)
+    assert out.returncode == 0
+    path = tmp_path / "gen.txt"
+    path.write_text(out.stdout)
+    m = mt.load_matrix_file(str(path), mesh)
+    arr = m.to_numpy()
+    assert arr.shape == (5, 4)
+    assert (arr >= 0).all() and (arr < 5).all()
+    # deterministic per seed
+    again = subprocess.run([exe, "5", "4", "7"], capture_output=True, text=True)
+    assert again.stdout == out.stdout
+    other = subprocess.run([exe, "5", "4", "8"], capture_output=True, text=True)
+    assert other.stdout != out.stdout

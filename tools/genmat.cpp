@@ -1,0 +1,77 @@
+// genmat — offline matrix data generator.
+//
+// Parity with the reference's tools/generateMatrix.cpp (26-line CLI that
+// prints "row:val,val,..." lines of uniform random floats in [0, 5) to
+// stdout; tools/README.md: ./genMat rows cols > file). This implementation
+// adds an optional seed argument for reproducibility and uses a fixed-width
+// fast PRNG + buffered output so multi-GB matrices generate at IO speed.
+//
+// Build: make -C tools      Usage: ./genmat rows cols [seed] > matrix.txt
+//
+// The emitted format is exactly what marlin_tpu.io.load_matrix_file (and the
+// reference's MTUtils.loadMatrixFile) parses.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+
+namespace {
+
+// xorshift128+ — small, fast, seedable.
+struct Rng {
+  uint64_t s0, s1;
+  explicit Rng(uint64_t seed) {
+    // splitmix64 to fill state from the seed
+    auto next = [&seed]() {
+      seed += 0x9e3779b97f4a7c15ULL;
+      uint64_t z = seed;
+      z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+      z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+      return z ^ (z >> 31);
+    };
+    s0 = next();
+    s1 = next();
+  }
+  uint64_t next() {
+    uint64_t x = s0;
+    const uint64_t y = s1;
+    s0 = y;
+    x ^= x << 23;
+    s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+    return s1 + y;
+  }
+  // uniform in [0, 5) like the reference generator
+  double uniform5() { return 5.0 * (next() >> 11) * (1.0 / 9007199254740992.0); }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s rows cols [seed] > matrix.txt\n", argv[0]);
+    return 1;
+  }
+  const long rows = std::strtol(argv[1], nullptr, 10);
+  const long cols = std::strtol(argv[2], nullptr, 10);
+  const uint64_t seed = argc > 3 ? std::strtoull(argv[3], nullptr, 10) : 42;
+  if (rows <= 0 || cols <= 0) {
+    std::fprintf(stderr, "rows and cols must be positive\n");
+    return 1;
+  }
+
+  Rng rng(seed);
+  // ~16 bytes per value is plenty for "%.6g,"
+  const size_t buf_size = 1 << 20;
+  static char buf[1 << 20];
+  std::setvbuf(stdout, buf, _IOFBF, buf_size);
+
+  for (long i = 0; i < rows; ++i) {
+    std::printf("%ld:", i);
+    for (long j = 0; j < cols; ++j) {
+      std::printf(j + 1 == cols ? "%.6g" : "%.6g,", rng.uniform5());
+    }
+    std::putchar('\n');
+  }
+  std::fflush(stdout);
+  return 0;
+}

@@ -66,9 +66,17 @@ def _rows_from_lines(lines):
 
 
 def load_matrix_file(path: str, mesh=None):
-    """``rowIdx:v,v,...`` → DenseVecMatrix (MTUtils.loadMatrixFile)."""
+    """``rowIdx:v,v,...`` → DenseVecMatrix (MTUtils.loadMatrixFile). Single
+    files go through the native C++ parser when built (marlin_tpu.native);
+    directories and fallback use the Python parser."""
     from ..matrix.dense import DenseVecMatrix
 
+    if os.path.isfile(path):
+        from .. import native
+
+        arr = native.load_matrix_text(path)
+        if arr is not None:
+            return DenseVecMatrix.from_array(arr, mesh)
     return DenseVecMatrix.from_array(_rows_from_lines(_iter_lines(path)), mesh)
 
 
@@ -170,9 +178,12 @@ def save_matrix(mat, path: str, fmt: str = "text", description: bool = False):
     arr = mat.to_numpy()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     if fmt == "text":
-        with open(path, "w") as f:
-            for i in range(arr.shape[0]):
-                f.write(f"{i}:" + ",".join(repr(float(x)) for x in arr[i]) + "\n")
+        from .. import native
+
+        if not native.save_matrix_text(path, arr):
+            with open(path, "w") as f:
+                for i in range(arr.shape[0]):
+                    f.write(f"{i}:" + ",".join(repr(float(x)) for x in arr[i]) + "\n")
     elif fmt == "block":
         # one block per mesh tile, column-major payload
         from ..matrix.dense import BlockMatrix

@@ -6,11 +6,11 @@ ARPACK's reverse-communication Lanczos loop running *on the driver* with each
 ``v ↦ AᵀA·v`` evaluated as a distributed aggregate — one full cluster
 round-trip per Lanczos iteration (DenseVecMatrix.scala:1743-1834, SURVEY.md §3).
 
-TPU-first, ARPACK disappears: the Lanczos recurrence itself is a
-``lax.scan`` over a jitted sharded mat-vec, so the *entire* iteration — k
-steps, full reorthogonalization, collectives — is one XLA program with zero
-host round-trips. The small tridiagonal eigenproblem is solved with ``eigh``
-at the end.
+TPU-first, ARPACK disappears: :func:`symmetric_eigs` runs the Lanczos
+recurrence itself as a ``lax.scan`` over a jitted matvec, so the *entire*
+iteration — k steps, full reorthogonalization, collectives — is one XLA
+program with zero host round-trips. :func:`lanczos` is the AᵀA specialization
+used by SVD.
 """
 
 from __future__ import annotations
@@ -24,7 +24,7 @@ import numpy as np
 
 from ..config import get_config
 
-__all__ = ["compute_svd", "lanczos", "SVDResult"]
+__all__ = ["compute_svd", "lanczos", "symmetric_eigs", "SVDResult"]
 
 
 @dataclasses.dataclass
@@ -36,63 +36,63 @@ class SVDResult:
     v: np.ndarray  # right singular vectors, (n, k)
 
 
-@functools.partial(jax.jit, static_argnames=("num_iters",))
-def _lanczos_tridiag(a: jax.Array, v0: jax.Array, num_iters: int):
-    """Lanczos with full reorthogonalization on the operator v ↦ Aᵀ(A v).
-    Returns (alphas, betas, Q) of the tridiagonalization."""
-    n = v0.shape[0]
-
-    def matvec(v):
-        return jnp.dot(a.T, jnp.dot(a, v, precision="highest"), precision="highest")
-
-    q0 = v0 / jnp.linalg.norm(v0)
-    qs = jnp.zeros((num_iters + 1, n), v0.dtype).at[0].set(q0)
-
-    def body(carry, i):
-        qs, beta_prev = carry
-        q = qs[i]
-        w = matvec(q)
-        alpha = jnp.dot(w, q)
-        w = w - alpha * q - beta_prev * qs[i - 1] * (i > 0)
-        # full reorthogonalization against all stored vectors (classical
-        # Gram-Schmidt twice is enough at these iteration counts)
-        for _ in range(2):
-            w = w - qs.T @ (qs @ w)
-        beta = jnp.linalg.norm(w)
-        q_next = jnp.where(beta > 1e-12, w / jnp.maximum(beta, 1e-30), jnp.zeros_like(w))
-        qs = qs.at[i + 1].set(q_next)
-        return (qs, beta), (alpha, beta)
-
-    (qs, _), (alphas, betas) = jax.lax.scan(
-        body, (qs, jnp.zeros((), v0.dtype)), jnp.arange(num_iters)
-    )
-    return alphas, betas, qs
-
-
-def lanczos(a: jax.Array, k: int, num_iters: int | None = None, seed: int = 0):
-    """Top-k eigenpairs of AᵀA by Lanczos — the role of ARPACK ``dsaupd``/
-    ``dseupd`` (DenseVecMatrix.symmetricEigs, DenseVecMatrix.scala:1743-1834).
-    Returns (eigenvalues desc, eigenvectors (n, k))."""
-    n = a.shape[1]
+def symmetric_eigs(matvec, n: int, k: int, num_iters: int | None = None,
+                   seed: int = 0, dtype=jnp.float32):
+    """Top-k eigenpairs of a symmetric operator given only ``v ↦ A·v`` — the
+    exact contract of the reference's ARPACK wrapper
+    (EigenValueDecomposition.symmetricEigs, DenseVecMatrix.scala:1743-1834),
+    with the reverse-communication loop replaced by a jitted Lanczos scan with
+    full (twice-iterated classical Gram-Schmidt) reorthogonalization.
+    ``matvec`` must be jax-traceable. Returns (eigenvalues desc, vectors (n, k))."""
     cfg = get_config()
     if num_iters is None:
         num_iters = min(n, max(2 * k + 1, min(n, k * cfg.lanczos_max_iter_factor)))
     num_iters = min(num_iters, n)
-    v0 = jax.random.normal(jax.random.key(seed), (n,), a.dtype)
-    alphas, betas, qs = _lanczos_tridiag(a, v0, num_iters)
-    t = (
-        jnp.diag(alphas)
-        + jnp.diag(betas[:-1], 1)
-        + jnp.diag(betas[:-1], -1)
-    )
+    v0 = jax.random.normal(jax.random.key(seed), (n,), dtype)
+
+    @functools.partial(jax.jit, static_argnames=("iters",))
+    def run(v0, iters):
+        q0 = v0 / jnp.linalg.norm(v0)
+        qs = jnp.zeros((iters + 1, n), v0.dtype).at[0].set(q0)
+
+        def body(carry, i):
+            qs, beta_prev = carry
+            q = qs[i]
+            w = matvec(q)
+            alpha = jnp.dot(w, q)
+            w = w - alpha * q - beta_prev * qs[i - 1] * (i > 0)
+            for _ in range(2):
+                w = w - qs.T @ (qs @ w)
+            beta = jnp.linalg.norm(w)
+            q_next = jnp.where(beta > 1e-12, w / jnp.maximum(beta, 1e-30),
+                               jnp.zeros_like(w))
+            qs = qs.at[i + 1].set(q_next)
+            return (qs, beta), (alpha, beta)
+
+        (qs, _), (alphas, betas) = jax.lax.scan(
+            body, (qs, jnp.zeros((), v0.dtype)), jnp.arange(iters)
+        )
+        return alphas, betas, qs
+
+    alphas, betas, qs = run(v0, num_iters)
+    t = jnp.diag(alphas) + jnp.diag(betas[:-1], 1) + jnp.diag(betas[:-1], -1)
     evals, evecs = jnp.linalg.eigh(t)
-    # eigh returns ascending; take top k
     idx = jnp.argsort(-evals)[:k]
-    evals_k = evals[idx]
     # Ritz vectors: Q[:iters].T @ evecs
     vecs = qs[:num_iters].T @ evecs[:, idx]
     vecs = vecs / jnp.maximum(jnp.linalg.norm(vecs, axis=0, keepdims=True), 1e-30)
-    return evals_k, vecs
+    return evals[idx], vecs
+
+
+def lanczos(a: jax.Array, k: int, num_iters: int | None = None, seed: int = 0):
+    """Top-k eigenpairs of AᵀA — the AᵀA specialization of
+    :func:`symmetric_eigs` used by the SVD path (the role of ARPACK
+    ``dsaupd``/``dseupd`` in the reference)."""
+
+    def matvec(v):
+        return jnp.dot(a.T, jnp.dot(a, v, precision="highest"), precision="highest")
+
+    return symmetric_eigs(matvec, a.shape[1], k, num_iters, seed, a.dtype)
 
 
 def compute_svd(mat, k: int, mode: str = "auto", compute_u: bool = True,

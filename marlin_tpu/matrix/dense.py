@@ -377,6 +377,37 @@ class DenseMatrix(DistributedMatrix):
         BlockMatrix.scala:575-594)."""
         return DenseVecMatrix.from_array(self.logical(), mesh or self.mesh)
 
+    def to_sparse_vec_matrix(self, tol: float = 0.0):
+        """Dense → sparse conversion (DenseVecMatrix.toSparseVecMatrix,
+        DenseVecMatrix.scala:1333-1353). Entries with |x| <= tol are dropped."""
+        from .sparse import SparseVecMatrix
+
+        arr = self.logical()
+        if tol > 0.0:
+            arr = jnp.where(jnp.abs(arr) > tol, arr, jnp.zeros((), arr.dtype))
+        return SparseVecMatrix.from_dense(arr, self.mesh)
+
+    def to_dataframe(self):
+        """Collect to a pandas DataFrame (the Spark-SQL ``toDataFrame`` analog,
+        DenseVecMatrix.scala:1381-1396); requires pandas."""
+        import pandas as pd
+
+        return pd.DataFrame(self.to_numpy())
+
+    def multiply_by(self, local_matrix, precision: str | None = None):
+        """``local @ self`` with the local operand replicated — the mirror of
+        ``multiply_broadcast`` (BlockMatrix.multiplyBy, BlockMatrix.scala:313-335)."""
+        from ..parallel.matmul import broadcast_matmul
+
+        local = jnp.asarray(
+            local_matrix.logical() if hasattr(local_matrix, "logical") else local_matrix
+        )
+        if local.shape[1] != self.num_rows():
+            raise ValueError(f"inner dim mismatch: {local.shape} @ {self.shape}")
+        out = broadcast_matmul(local, self.logical(),
+                               NamedSharding(self.mesh, self.spec), "a", precision)
+        return self._wrap(out)
+
     def reshard(self, spec: P, mesh: Mesh | None = None) -> "DenseMatrix":
         """General re-layout (the analog of BlockMatrix.toBlockMatrix(r, c)
         re-blocking, BlockMatrix.scala:610-665)."""

@@ -25,6 +25,7 @@ from jax.sharding import Mesh
 from ..config import get_config
 from ..mesh import default_mesh
 from ..ops.local import mult_sparse_dense, mult_sparse_sparse
+from ..ops.sparse_ell import ell_from_coo, ell_spmm
 
 __all__ = ["SparseVecMatrix", "CoordinateMatrix"]
 
@@ -155,15 +156,44 @@ class SparseVecMatrix:
         return CoordinateMatrix(out.indices[:, 0], out.indices[:, 1], out.data,
                                 shape=(self.num_rows(), other.num_cols()), mesh=self.mesh)
 
-    def multiply(self, other):
-        """Sparse × dense → dense distributed matrix."""
+    def multiply(self, other, format: str = "auto"):
+        """Sparse × dense → dense distributed matrix.
+
+        ``format``: "bcoo" uses the BCOO dot_general; "ell" uses the chunked
+        gather SpMM (marlin_tpu.ops.sparse_ell — the config-5 low-density
+        path); "auto" picks ELL below ~1% density."""
         from .dense import BlockMatrix, DenseMatrix
 
         if isinstance(other, SparseVecMatrix):
             return self.multiply_sparse(other)
         dense = other.logical() if isinstance(other, DenseMatrix) else jnp.asarray(other)
-        out = mult_sparse_dense(self.bcoo, dense)
+        if format == "auto":
+            density = self.nnz / max(1, self._shape[0] * self._shape[1])
+            format = "ell" if density < 0.01 else "bcoo"
+        if format == "ell":
+            out = ell_spmm(self.to_ell(), dense)
+        elif format == "bcoo":
+            out = mult_sparse_dense(self.bcoo, dense)
+        else:
+            raise ValueError(f"unknown SpMM format: {format}")
         return BlockMatrix.from_array(out, self.mesh)
+
+    def to_ell(self, k_width: int | None = None):
+        """Convert to ELL storage (cached). ``k_width=None`` caps the padded
+        row width at 4× the mean degree (min 8): a single dense hub row must
+        not inflate the (rows × K) arrays to dense-matrix size — overflow
+        entries go to the exact BCOO residual instead."""
+        if getattr(self, "_ell", None) is None:
+            b = self.bcoo.sum_duplicates()
+            rows = np.asarray(b.indices[:, 0])
+            if k_width is None:
+                mean_deg = b.nse / max(1, self._shape[0])
+                k_width = max(8, int(4 * mean_deg) + 1)
+            self._ell = ell_from_coo(
+                rows, np.asarray(b.indices[:, 1]), np.asarray(b.data),
+                self._shape, k_width=k_width,
+            )
+        return self._ell
 
     def to_dense_vec_matrix(self, mesh: Mesh | None = None):
         """Densify (SparseVecMatrix.toDenseVecMatrix, SparseVecMatrix.scala:56-65)."""

@@ -50,9 +50,9 @@ class ALSModel:
 @functools.partial(jax.jit, static_argnames=("num_segments", "weighted"))
 def _solve_side(factors_other, seg_ids, other_ids, ratings, rank, lam,
                 num_segments, weighted):
-    """One half-step: recompute `num_segments` factor rows from the fixed other
-    side. seg_ids: which row each rating belongs to; other_ids: which fixed
-    factor it references."""
+    """One explicit half-step: recompute `num_segments` factor rows from the
+    fixed other side. seg_ids: which row each rating belongs to; other_ids:
+    which fixed factor it references."""
     vt = factors_other[other_ids]  # (nnz, rank) gathered
     # per-rating normal-equation contributions (the vectorized dspr loop,
     # ALSHelp.scala:292-382)
@@ -69,12 +69,37 @@ def _solve_side(factors_other, seg_ids, other_ids, ratings, rank, lam,
     return jnp.where(counts[:, None] > 0, sol, jnp.zeros_like(sol))
 
 
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def _solve_side_implicit(factors_other, seg_ids, other_ids, ratings, lam, alpha,
+                         num_segments):
+    """One implicit-feedback half-step (Hu/Koren/Volinsky; the role of the
+    reference's implicitPrefs path with its computeYtY precompute,
+    ALSHelp.scala:188-200, 292-382): solve
+    (YᵀY + Yᵀ(C−I)Y + λI) x = Yᵀ C p  per row, with the dense YᵀY computed
+    once globally and only the (c−1)-weighted corrections segment-summed."""
+    vt = factors_other[other_ids]  # (nnz, rank)
+    yty = jnp.dot(factors_other.T, factors_other, precision="highest")
+    conf_minus_1 = alpha * ratings  # c = 1 + alpha*r
+    outer = vt[:, :, None] * vt[:, None, :] * conf_minus_1[:, None, None]
+    corr = jax.ops.segment_sum(outer, seg_ids, num_segments)
+    # preference p = 1 for observed entries; rhs = Σ c·p·v
+    rhs = jax.ops.segment_sum(vt * (1.0 + conf_minus_1)[:, None], seg_ids, num_segments)
+    counts = jax.ops.segment_sum(jnp.ones_like(ratings), seg_ids, num_segments)
+    eye = jnp.eye(yty.shape[0], dtype=yty.dtype)
+    a = yty[None] + corr + lam * eye[None]
+    sol = jnp.linalg.solve(a, rhs[..., None])[..., 0]
+    return jnp.where(counts[:, None] > 0, sol, jnp.zeros_like(sol))
+
+
 def als_run(ratings, rank: int, iterations: int = 10, lam: float = 0.01,
-            seed: int = 0, weighted_lambda: bool = True, mesh=None) -> ALSModel:
+            seed: int = 0, weighted_lambda: bool = True, mesh=None,
+            implicit_prefs: bool = False, alpha: float = 1.0) -> ALSModel:
     """Run blocked ALS (ALSHelp.ALSRun, ml/ALSHelp.scala:34-96).
 
     ``ratings`` is a CoordinateMatrix of (user, product, rating). Factors are
     initialized on the unit sphere like ``randomFactor`` (ALSHelp.scala:170-179).
+    ``implicit_prefs``/``alpha`` select the implicit-feedback formulation, the
+    same switch ALSRun takes (ALSHelp.scala:33-34).
     """
     from ..matrix.dense import DenseVecMatrix
 
@@ -92,8 +117,12 @@ def als_run(ratings, rank: int, iterations: int = 10, lam: float = 0.01,
 
     for _ in range(iterations):
         # products fixed -> update users, then users fixed -> update products
-        u = _solve_side(v, users, items, vals, rank, lam, num_users, weighted_lambda)
-        v = _solve_side(u, items, users, vals, rank, lam, num_items, weighted_lambda)
+        if implicit_prefs:
+            u = _solve_side_implicit(v, users, items, vals, lam, alpha, num_users)
+            v = _solve_side_implicit(u, items, users, vals, lam, alpha, num_items)
+        else:
+            u = _solve_side(v, users, items, vals, rank, lam, num_users, weighted_lambda)
+            v = _solve_side(u, items, users, vals, rank, lam, num_items, weighted_lambda)
 
     return ALSModel(
         DenseVecMatrix.from_array(u, mesh),

@@ -1,0 +1,96 @@
+"""ctypes bindings for the native text-IO library, with auto-build.
+
+The reference's data loading is Spark-JVM-side (MTUtils loaders); the
+TPU-native runtime keeps the data plane in C++ (textio.cpp) and binds it here
+via ctypes — no pybind11 dependency. If the shared object is missing, we try
+one `make` (the toolchain is a build-time requirement, not runtime), and fall
+back to the pure-Python parser in marlin_tpu.io.text otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libmarlin_textio.so")
+_lib = None
+_tried_build = False
+
+
+def _load():
+    global _lib, _tried_build
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO) and not _tried_build:
+        _tried_build = True
+        try:
+            subprocess.run(["make", "-s", "-C", _HERE],
+                           capture_output=True, timeout=120)
+        except Exception:
+            pass
+    if os.path.exists(_SO):
+        lib = ctypes.CDLL(_SO)
+        lib.mt_count_matrix.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.mt_count_matrix.restype = ctypes.c_int
+        lib.mt_load_matrix.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.mt_load_matrix.restype = ctypes.c_int
+        lib.mt_save_matrix.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.mt_save_matrix.restype = ctypes.c_int
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def load_matrix_text(path: str) -> np.ndarray | None:
+    """Parse a row-text matrix file natively; None if the library is absent."""
+    lib = _load()
+    if lib is None:
+        return None
+    import errno as _errno
+
+    rows, cols = ctypes.c_int64(), ctypes.c_int64()
+    rc = lib.mt_count_matrix(path.encode(), ctypes.byref(rows), ctypes.byref(cols))
+    if -rc == _errno.EINVAL:
+        raise ValueError(f"unparseable numeric token in {path}")
+    if rc != 0:
+        raise OSError(-rc, f"native count failed for {path}")
+    out = np.zeros((rows.value, cols.value), np.float64)
+    rc = lib.mt_load_matrix(path.encode(), out, rows.value, cols.value)
+    if -rc == _errno.EINVAL:
+        raise ValueError(f"unparseable numeric token in {path}")
+    if rc != 0:
+        raise OSError(-rc, f"native load failed for {path}")
+    return out
+
+
+def save_matrix_text(path: str, data: np.ndarray) -> bool:
+    """Write a row-text matrix file natively; False if the library is absent."""
+    lib = _load()
+    if lib is None:
+        return False
+    arr = np.ascontiguousarray(data, np.float64)
+    rc = lib.mt_save_matrix(path.encode(), arr, arr.shape[0], arr.shape[1])
+    if rc != 0:
+        raise OSError(-rc, f"native save failed for {path}")
+    return True

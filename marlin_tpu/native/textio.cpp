@@ -1,0 +1,149 @@
+// textio — native parser/writer for the Marlin row-text matrix format.
+//
+// The reference's data plane is JVM-side: Spark textFile + per-line
+// String.split parsing (MTUtils.loadMatrixFile, utils/MTUtils.scala:286-300).
+// Python's equivalent (str.split + float()) parses at ~30 MB/s, which turns
+// multi-GB matrix loads into minutes. This C library parses the
+// "rowIdx:v,v,..." format at memory-bandwidth-ish speed and is exposed to
+// Python via ctypes (marlin_tpu/native/__init__.py) with a pure-Python
+// fallback when the shared object hasn't been built.
+//
+// Build: make -C marlin_tpu/native   (produces libmarlin_textio.so)
+//
+// Exported C ABI (all return 0 on success, negative on error):
+//   mt_count_matrix(path, *rows, *cols)   — scan pass: dimensions
+//   mt_load_matrix(path, out, rows, cols) — parse pass: fill row-major f64
+//   mt_save_matrix(path, data, rows, cols)— write the same format
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+// Fast float parse: strtod is locale-burdened but correct; for bulk numeric
+// text it is still ~10x faster than Python's float() round-trip. Keep it.
+inline const char* skip_seps(const char* p, const char* end) {
+  // the reference's separator rule: ",\s?|\s+"
+  while (p < end && (*p == ',' || *p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+struct FileBuf {
+  char* data = nullptr;
+  size_t size = 0;
+  ~FileBuf() { std::free(data); }
+  int read(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return -errno;
+    std::fseek(f, 0, SEEK_END);
+    long n = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    data = static_cast<char*>(std::malloc(n + 1));
+    if (!data) {
+      std::fclose(f);
+      return -ENOMEM;
+    }
+    size = std::fread(data, 1, n, f);
+    data[size] = '\0';
+    std::fclose(f);
+    return 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+int mt_count_matrix(const char* path, int64_t* rows, int64_t* cols) {
+  FileBuf buf;
+  if (int rc = buf.read(path); rc != 0) return rc;
+  int64_t max_row = -1, ncols = 0;
+  const char* p = buf.data;
+  const char* end = buf.data + buf.size;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(std::memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    const char* colon = static_cast<const char*>(std::memchr(p, ':', line_end - p));
+    if (colon) {
+      char* after = nullptr;
+      long long r = std::strtoll(p, &after, 10);
+      if (after && after <= colon) {
+        if (r > max_row) max_row = r;
+        // count values on every line: ragged inputs get the max width,
+        // matching the Python parser's behavior. An unparseable token is a
+        // hard error (the Python parser raises there too) — never silently
+        // truncate.
+        int64_t line_cols = 0;
+        const char* q = colon + 1;
+        while (q < line_end) {
+          q = skip_seps(q, line_end);
+          if (q >= line_end) break;
+          char* next = nullptr;
+          std::strtod(q, &next);
+          if (next == q) return -EINVAL;
+          ++line_cols;
+          q = next;
+        }
+        if (line_cols > ncols) ncols = line_cols;
+      }
+    }
+    p = line_end + 1;
+  }
+  *rows = max_row + 1;
+  *cols = ncols;
+  return 0;
+}
+
+int mt_load_matrix(const char* path, double* out, int64_t rows, int64_t cols) {
+  FileBuf buf;
+  if (int rc = buf.read(path); rc != 0) return rc;
+  const char* p = buf.data;
+  const char* end = buf.data + buf.size;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(std::memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    const char* colon = static_cast<const char*>(std::memchr(p, ':', line_end - p));
+    if (colon) {
+      char* after = nullptr;
+      long long r = std::strtoll(p, &after, 10);
+      if (after && after <= colon && r >= 0 && r < rows) {
+        double* row_out = out + r * cols;
+        const char* q = colon + 1;
+        int64_t j = 0;
+        while (q < line_end && j < cols) {
+          q = skip_seps(q, line_end);
+          if (q >= line_end) break;
+          char* next = nullptr;
+          double v = std::strtod(q, &next);
+          if (next == q) return -EINVAL;  // corrupt token: fail, don't zero-fill
+          row_out[j++] = v;
+          q = next;
+        }
+      }
+    }
+    p = line_end + 1;
+  }
+  return 0;
+}
+
+int mt_save_matrix(const char* path, const double* data, int64_t rows, int64_t cols) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -errno;
+  static char iobuf[1 << 20];
+  std::setvbuf(f, iobuf, _IOFBF, sizeof(iobuf));
+  for (int64_t i = 0; i < rows; ++i) {
+    std::fprintf(f, "%lld:", static_cast<long long>(i));
+    const double* row = data + i * cols;
+    for (int64_t j = 0; j < cols; ++j) {
+      std::fprintf(f, j + 1 == cols ? "%.17g" : "%.17g,", row[j]);
+    }
+    std::fputc('\n', f);
+  }
+  std::fclose(f);
+  return 0;
+}
+
+}  // extern "C"

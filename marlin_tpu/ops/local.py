@@ -28,9 +28,23 @@ def _precision(precision: str | None):
     return precision or get_config().matmul_precision
 
 
-def gemm(a: jax.Array, b: jax.Array, precision: str | None = None) -> jax.Array:
+def gemm(a: jax.Array, b: jax.Array, precision: str | None = None,
+         backend: str = "xla") -> jax.Array:
     """Dense block GEMM: the dgemm reached through Breeze ``BDM * BDM`` in the
-    reference (SubMatrix.scala:92). Accumulates in float32 on the MXU."""
+    reference (SubMatrix.scala:92). Accumulates in float32 on the MXU.
+
+    ``backend="pallas"`` routes through the hand-written tiled kernel
+    (ops.pallas_kernels.pallas_matmul) — useful for kernel experiments; the
+    XLA dot is the production default."""
+    if backend == "pallas":
+        from .pallas_kernels import pallas_matmul
+
+        if precision is not None:
+            raise ValueError(
+                "backend='pallas' always accumulates in f32; the precision "
+                "argument is not honored there — pass precision=None"
+            )
+        return pallas_matmul(a, b)
     return jnp.dot(
         a, b, precision=_precision(precision), preferred_element_type=a.dtype
     )

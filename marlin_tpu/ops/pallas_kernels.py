@@ -1,0 +1,104 @@
+"""Pallas TPU kernels — the hand-written layer below XLA.
+
+The reference's hand-tuned layer is LibMatrixMult's cache-blocked CPU kernels
+(LibMatrixMult.scala:15-77); on TPU, XLA's dot fusion already covers the dense
+hot path, so Pallas is reserved for the places the compiler can't schedule:
+
+- :func:`pallas_matmul` — a k-accumulating tiled MXU matmul. It exists as the
+  pluggable "write your own GEMM" backend (config/benchmark comparisons vs the
+  XLA dot; `ops.gemm(backend="pallas")`), and as the template other fused
+  kernels in this module grow from.
+- :func:`masked_fill` — fused pad-masking (iota compare + select) used by the
+  zero-pad invariant; one VPU pass, no intermediate materialization.
+
+On non-TPU backends (the CPU test mesh) kernels run in interpreter mode —
+same numerics, no Mosaic compile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_spec(shape, index_map):
+    return pl.BlockSpec(shape, index_map, memory_space=pltpu.VMEM)
+
+
+def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref):
+    """Grid (m/bm, n/bn, k/bk): accumulate partial products in an f32 VMEM
+    scratch across the k dimension (innermost grid axis)."""
+    k_idx = pl.program_id(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(
+        a_ref[:], b_ref[:], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(k_idx == pl.num_programs(2) - 1)
+    def _flush():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "bk"))
+def pallas_matmul(a: jax.Array, b: jax.Array, bm: int = 256, bn: int = 256,
+                  bk: int = 512) -> jax.Array:
+    """Tiled MXU matmul ``a @ b`` (f32 accumulation). Inputs are padded to the
+    tile grid and the result sliced back — same contract as ops.gemm."""
+    m, k = a.shape
+    k2, n = b.shape
+    if k != k2:
+        raise ValueError(f"inner dimensions mismatch: {a.shape} @ {b.shape}")
+    bm, bn, bk = min(bm, max(8, m)), min(bn, max(128, n)), min(bk, max(128, k))
+    mp = (m + bm - 1) // bm * bm
+    np_ = (n + bn - 1) // bn * bn
+    kp = (k + bk - 1) // bk * bk
+    if (mp, kp) != (m, k):
+        a = jnp.pad(a, ((0, mp - m), (0, kp - k)))
+    if (kp, np_) != (k, n):
+        b = jnp.pad(b, ((0, kp - k), (0, np_ - n)))
+
+    grid = (mp // bm, np_ // bn, kp // bk)
+    scratch = [pltpu.VMEM((bm, bn), jnp.float32)]
+    out = pl.pallas_call(
+        _matmul_kernel,
+        grid=grid,
+        in_specs=[
+            _block_spec((bm, bk), lambda i, j, kk: (i, kk)),
+            _block_spec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=_block_spec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), a.dtype),
+        scratch_shapes=scratch,
+        interpret=_interpret(),
+    )(a, b)
+    return out[:m, :n]
+
+
+def _masked_fill_kernel(x_ref, o_ref, *, rows, cols):
+    r = jax.lax.broadcasted_iota(jnp.int32, o_ref.shape, 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, o_ref.shape, 1)
+    o_ref[:] = jnp.where((r < rows) & (c < cols), x_ref[:], jnp.zeros((), o_ref.dtype))
+
+
+@functools.partial(jax.jit, static_argnames=("rows", "cols"))
+def masked_fill(x: jax.Array, rows: int, cols: int) -> jax.Array:
+    """Zero everything outside the logical (rows, cols) region — the pad
+    invariant restore, as a single fused VPU pass."""
+    kernel = functools.partial(_masked_fill_kernel, rows=rows, cols=cols)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_interpret(),
+    )(x)

@@ -1,0 +1,114 @@
+"""ELL-format SpMM for very sparse × dense products on TPU.
+
+The reference's sparse hot path is hand-rolled CSC-traversal GEMM with 32×32
+cache blocking (LibMatrixMult.scala:43-77) — a CPU-cache design with no TPU
+analog. BCOO ``dot_general`` handles moderate densities, but for the
+BASELINE.md config-5 regime (10⁻⁴ density, ~100 nnz/row) the TPU-shaped layout
+is **ELL**: pad every row's nonzeros to a fixed width K, giving dense
+``(rows, K)`` index/value arrays. SpMM is then a row-chunked
+gather-and-contract — ``einsum('rk,rkn->rn', vals, B[cols])`` under
+``lax.map`` — whose cost is the unavoidable one-B-row-read-per-nnz HBM
+traffic; all shapes are static, everything lands on the VPU/MXU.
+
+Rows are independent, so the chunked loop also shards cleanly over the mesh
+(rows axis), and overflow beyond K falls back to a BCOO product for the
+residual entries (exact, not lossy).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+__all__ = ["ell_from_coo", "ell_spmm", "EllMatrix"]
+
+
+class EllMatrix:
+    """ELL storage: ``cols``/``vals`` of shape (rows, K); padding slots have
+    col=0, val=0 (contributing exactly zero). ``residual`` holds overflow
+    entries (rows with more than K nonzeros) as a BCOO, or None."""
+
+    def __init__(self, cols, vals, shape, residual=None):
+        self.cols = cols
+        self.vals = vals
+        self.shape = tuple(int(s) for s in shape)
+        self.residual = residual
+
+    @property
+    def k_width(self) -> int:
+        return self.cols.shape[1]
+
+    @property
+    def nnz(self) -> int:
+        n = int((self.vals != 0).sum())
+        return n + (int(self.residual.nse) if self.residual is not None else 0)
+
+
+def ell_from_coo(rows, cols, vals, shape, k_width: int | None = None) -> EllMatrix:
+    """Pack COO triplets into ELL. ``k_width=None`` uses the max row degree."""
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    vals = np.asarray(vals)
+    m, n = shape
+    order = np.argsort(rows, kind="stable")
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    counts = np.bincount(rows, minlength=m)
+    max_deg = int(counts.max()) if counts.size else 0
+    k = max(1, max_deg if k_width is None else k_width)
+
+    # slot position of each entry within its row
+    starts = np.zeros(m + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    slot = np.arange(len(rows)) - starts[rows]
+
+    in_ell = slot < k
+    ell_cols = np.zeros((m, k), np.int32)
+    ell_vals = np.zeros((m, k), vals.dtype)
+    ell_cols[rows[in_ell], slot[in_ell]] = cols[in_ell]
+    ell_vals[rows[in_ell], slot[in_ell]] = vals[in_ell]
+
+    residual = None
+    if (~in_ell).any():
+        idx = np.stack([rows[~in_ell], cols[~in_ell]], axis=1)
+        residual = jsparse.BCOO(
+            (jnp.asarray(vals[~in_ell]), jnp.asarray(idx)), shape=shape
+        )
+    return EllMatrix(jnp.asarray(ell_cols), jnp.asarray(ell_vals), shape, residual)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def _ell_spmm_chunked(cols, vals, b, chunk: int):
+    m = cols.shape[0]
+    n_chunks = m // chunk  # m pre-padded to a multiple of chunk
+
+    def body(i):
+        c = jax.lax.dynamic_slice(cols, (i * chunk, 0), (chunk, cols.shape[1]))
+        v = jax.lax.dynamic_slice(vals, (i * chunk, 0), (chunk, vals.shape[1]))
+        gathered = b[c]  # (chunk, K, n) gather
+        return jnp.einsum("rk,rkn->rn", v, gathered)
+
+    out = jax.lax.map(body, jnp.arange(n_chunks))
+    return out.reshape(m, b.shape[1])
+
+
+def ell_spmm(ell: EllMatrix, b, chunk: int = 1024) -> jax.Array:
+    """``ell @ b`` with dense result. ``chunk`` bounds the gather buffer to
+    chunk × K × n_cols elements."""
+    b = jnp.asarray(b.logical() if hasattr(b, "logical") else b)
+    m, kdim = ell.shape
+    if b.shape[0] != kdim:
+        raise ValueError(f"inner dim mismatch: {ell.shape} @ {b.shape}")
+    chunk = min(chunk, max(1, m))
+    m_pad = ((m + chunk - 1) // chunk) * chunk
+    cols, vals = ell.cols, ell.vals
+    if m_pad != m:
+        cols = jnp.pad(cols, ((0, m_pad - m), (0, 0)))
+        vals = jnp.pad(vals, ((0, m_pad - m), (0, 0)))
+    out = _ell_spmm_chunked(cols, vals, b, chunk)[:m]
+    if ell.residual is not None:
+        out = out + ell.residual @ b
+    return out

@@ -226,4 +226,11 @@ def matmul(
         )
     if strategy == "gspmd":
         return gspmd_matmul(a, b, out_sharding, precision, accum_dtype)
+    if strategy == "ring":
+        from .ring import ring_matmul
+
+        return ring_matmul(
+            a, b, out_sharding.mesh, out_sharding.mesh.axis_names[0],
+            precision, accum_dtype,
+        )
     raise ValueError(f"unknown matmul strategy: {strategy}")

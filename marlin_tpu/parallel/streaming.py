@@ -1,0 +1,114 @@
+"""Out-of-core streaming for matrices bigger than device HBM.
+
+The reference spills oversized matrices via Spark's disk-backed RDDs
+(SURVEY.md §7 hard parts: "Matrices bigger than the TPU pod's HBM: Marlin
+spills via Spark; the rebuild needs host-offload streaming of blocks"). This
+module is that layer for the tall-skinny workloads (BASELINE.md config 4:
+10⁷×512 · 512×512): the tall operand lives on the host (numpy array, memmap, or
+a chunk generator), row-chunks are streamed through device HBM double-buffered
+(dispatch chunk i+1 before synchronizing chunk i), and either
+
+- :func:`streamed_matmul` — each chunk is multiplied against a resident
+  (replicated/sharded) right-hand side and the result streams back to host, or
+- :func:`streamed_gramian` — AᵀA accumulates *on device* (the reference's
+  Gramian aggregate, DenseVecMatrix.scala:1444-1486) and only the n×n result
+  ever leaves.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import get_config
+
+__all__ = ["streamed_matmul", "streamed_gramian", "iter_row_chunks"]
+
+
+def iter_row_chunks(a, chunk_rows: int) -> Iterator[np.ndarray]:
+    """Yield row chunks from an ndarray/memmap (zero-copy views)."""
+    for start in range(0, a.shape[0], chunk_rows):
+        yield a[start : start + chunk_rows]
+
+
+def _as_chunks(a_source, chunk_rows: int) -> Iterable[np.ndarray]:
+    if hasattr(a_source, "shape") and hasattr(a_source, "__getitem__"):
+        return iter_row_chunks(a_source, chunk_rows)
+    return a_source  # already an iterable of chunks
+
+
+def streamed_matmul(
+    a_source,
+    b,
+    chunk_rows: int = 1 << 18,
+    out: np.ndarray | None = None,
+    precision: str | None = None,
+) -> np.ndarray | None:
+    """``A @ B`` where A streams through the device in row chunks.
+
+    ``a_source``: ndarray/memmap or iterable of row-chunk ndarrays.
+    ``b``: (k, n) array or DenseMatrix, resident on device.
+    ``out``: optional preallocated (m, n) host array (e.g. a writable memmap)
+    filled in place; otherwise chunks are collected and stacked (only sensible
+    when the result fits host RAM).
+    """
+    precision = precision or get_config().matmul_precision
+    b_dev = jnp.asarray(b.logical() if hasattr(b, "logical") else b)
+
+    @jax.jit
+    def chunk_mm(x):
+        return jnp.dot(x, b_dev, precision=precision)
+
+    results, offset, pending, saw_chunk = [], 0, [], False
+
+    def drain(limit: int):
+        nonlocal offset
+        while len(pending) > limit:
+            y = pending.pop(0)
+            y_np = np.asarray(jax.device_get(y))
+            if out is not None:
+                out[offset : offset + y_np.shape[0]] = y_np
+            else:
+                results.append(y_np)
+            offset += y_np.shape[0]
+
+    for chunk in _as_chunks(a_source, chunk_rows):
+        saw_chunk = True
+        pending.append(chunk_mm(jnp.asarray(chunk)))
+        drain(1)  # keep one chunk in flight: overlap H2D/compute/D2H
+    if not saw_chunk:
+        raise ValueError("empty input stream")
+    drain(0)
+    return out if out is not None else np.concatenate(results, axis=0)
+
+
+def streamed_gramian(
+    a_source,
+    n_cols: int | None = None,
+    chunk_rows: int = 1 << 18,
+    precision: str | None = None,
+    dtype=jnp.float32,
+) -> np.ndarray:
+    """``AᵀA`` with A streamed in row chunks and the n×n accumulator resident
+    on device — one rank-chunk ``syrk`` per chunk, no driver reduction."""
+    precision = precision or get_config().matmul_precision
+
+    @jax.jit
+    def accumulate(g, x):
+        return g + jnp.dot(x.T, x, precision=precision)
+
+    g = None
+    for chunk in _as_chunks(a_source, chunk_rows):
+        x = jnp.asarray(chunk, dtype=dtype)
+        if n_cols is not None and x.shape[1] != n_cols:
+            raise ValueError(f"chunk has {x.shape[1]} cols, expected {n_cols}")
+        if g is None:
+            n_cols = x.shape[1]
+            g = jnp.zeros((n_cols, n_cols), dtype)
+        g = accumulate(g, x)
+    if g is None:
+        raise ValueError("empty input stream")
+    return np.asarray(jax.device_get(g))

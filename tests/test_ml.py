@@ -53,3 +53,20 @@ def test_carma_prefers_long_dim():
 def test_near_square_split():
     assert near_square_split(9) == 3
     assert near_square_split(1) >= 1
+
+
+def test_als_implicit_prefs(mesh):
+    # implicit feedback: observed entries should score higher than unobserved
+    rng = np.random.default_rng(1)
+    n_users, n_items = 25, 15
+    mask = rng.random((n_users, n_items)) < 0.3
+    ui, ii = np.nonzero(mask)
+    counts = rng.integers(1, 10, len(ui)).astype(np.float32)  # interaction counts
+    coo = mt.CoordinateMatrix(ui, ii, counts, shape=(n_users, n_items), mesh=mesh)
+    model = coo.als(rank=4, iterations=10, lam=0.1, implicit_prefs=True, alpha=10.0)
+    u = model.user_features.to_numpy()
+    v = model.product_features.to_numpy()
+    scores = u @ v.T
+    obs_mean = scores[mask].mean()
+    unobs_mean = scores[~mask].mean()
+    assert obs_mean > unobs_mean + 0.1, (obs_mean, unobs_mean)

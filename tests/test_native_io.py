@@ -1,0 +1,74 @@
+"""Native C++ text-IO parity with the Python parser."""
+
+import numpy as np
+import pytest
+
+from marlin_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib_ok():
+    if not native.available():
+        pytest.skip("native textio library not built (no toolchain?)")
+    return True
+
+
+def test_native_roundtrip(tmp_path, lib_ok):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((37, 11))
+    p = str(tmp_path / "m.txt")
+    assert native.save_matrix_text(p, a)
+    back = native.load_matrix_text(p)
+    np.testing.assert_allclose(back, a)  # %.17g roundtrips f64 exactly
+
+
+def test_native_matches_python_parser(tmp_path, lib_ok):
+    p = str(tmp_path / "m.txt")
+    with open(p, "w") as f:
+        f.write("0:1.5 2.0,3.25\n2:4.0, 5.0 6.5\n")  # mixed separators + row gap
+    from marlin_tpu.io.text import _iter_lines, _rows_from_lines
+
+    py = _rows_from_lines(_iter_lines(p))
+    nat = native.load_matrix_text(p)
+    np.testing.assert_allclose(nat, py)
+    assert nat.shape == (3, 3)
+    assert nat[1].sum() == 0  # missing row -> zeros
+
+
+def test_native_ragged_rows(tmp_path, lib_ok):
+    p = str(tmp_path / "r.txt")
+    with open(p, "w") as f:
+        f.write("0:1.0\n1:2.0,3.0,4.0\n")
+    nat = native.load_matrix_text(p)
+    assert nat.shape == (2, 3)
+    np.testing.assert_allclose(nat, [[1.0, 0.0, 0.0], [2.0, 3.0, 4.0]])
+
+
+def test_framework_uses_native_path(tmp_path, mesh, lib_ok):
+    import marlin_tpu as mt
+
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((20, 6)).astype(np.float32)
+    m = mt.DenseVecMatrix.from_array(a, mesh)
+    p = str(tmp_path / "n.txt")
+    m.save_to_file_system(p)
+    loaded = mt.load_matrix_file(p, mesh)
+    np.testing.assert_allclose(loaded.to_numpy(), a, rtol=1e-6, atol=1e-6)
+
+
+def test_native_speed_sanity(tmp_path, lib_ok):
+    # not a benchmark, just "doesn't blow up on a few MB"
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((2000, 200))
+    p = str(tmp_path / "big.txt")
+    native.save_matrix_text(p, a)
+    back = native.load_matrix_text(p)
+    np.testing.assert_allclose(back, a)
+
+
+def test_native_corrupt_token_raises(tmp_path, lib_ok):
+    p = str(tmp_path / "bad.txt")
+    with open(p, "w") as f:
+        f.write("0:1.0,2.0,x,4.0\n")
+    with pytest.raises(ValueError):
+        native.load_matrix_text(p)

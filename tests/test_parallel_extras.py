@@ -1,0 +1,145 @@
+"""Ring matmul, streamed out-of-core paths, ELL SpMM, symmetric_eigs."""
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+from marlin_tpu.ops.sparse_ell import ell_from_coo, ell_spmm
+from marlin_tpu.parallel import ring_matmul, streamed_gramian, streamed_matmul
+
+
+def test_ring_matmul_matches_oracle(mesh):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((24, 40)).astype(np.float32)
+    b = rng.standard_normal((40, 16)).astype(np.float32)
+    import jax.numpy as jnp
+
+    c = ring_matmul(jnp.asarray(a), jnp.asarray(b), mesh)
+    np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_ring_matmul_uneven(mesh):
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((13, 9)).astype(np.float32)   # neither divisible by 2
+    b = rng.standard_normal((9, 5)).astype(np.float32)
+    import jax.numpy as jnp
+
+    c = ring_matmul(jnp.asarray(a), jnp.asarray(b), mesh)
+    np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_ring_strategy_via_matrix(mesh):
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((32, 32)).astype(np.float32)
+    b = rng.standard_normal((32, 32)).astype(np.float32)
+    ma = mt.DenseVecMatrix.from_array(a, mesh)
+    mb = mt.DenseVecMatrix.from_array(b, mesh)
+    out = ma.multiply(mb, strategy="ring")
+    np.testing.assert_allclose(out.to_numpy(), a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_streamed_matmul(mesh):
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((1000, 32)).astype(np.float32)
+    b = rng.standard_normal((32, 8)).astype(np.float32)
+    out = streamed_matmul(a, b, chunk_rows=128)
+    np.testing.assert_allclose(out, a @ b, rtol=1e-4, atol=1e-4)
+    # preallocated out buffer (memmap path)
+    buf = np.zeros((1000, 8), np.float32)
+    streamed_matmul(a, b, chunk_rows=256, out=buf)
+    np.testing.assert_allclose(buf, a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_streamed_matmul_generator():
+    rng = np.random.default_rng(4)
+    chunks = [rng.standard_normal((100, 16)).astype(np.float32) for _ in range(5)]
+    b = rng.standard_normal((16, 4)).astype(np.float32)
+    out = streamed_matmul(iter(chunks), b)
+    np.testing.assert_allclose(out, np.concatenate(chunks) @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_streamed_gramian():
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((2000, 24)).astype(np.float32)
+    g = streamed_gramian(a, chunk_rows=300)
+    np.testing.assert_allclose(g, a.T @ a, rtol=1e-3, atol=1e-3)
+    with pytest.raises(ValueError):
+        streamed_gramian(iter([]))
+
+
+def _random_coo(m, n, nnz, seed):
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, m, nnz)
+    cols = rng.integers(0, n, nnz)
+    vals = rng.standard_normal(nnz).astype(np.float32)
+    dense = np.zeros((m, n), np.float32)
+    np.add.at(dense, (rows, cols), vals)
+    return rows, cols, vals, dense
+
+
+def test_ell_spmm_matches_dense():
+    m, n, p = 200, 150, 40
+    rows, cols, vals, dense = _random_coo(m, n, 600, 6)
+    ell = ell_from_coo(rows, cols, vals, (m, n))
+    assert ell.residual is None
+    b = np.random.default_rng(7).standard_normal((n, p)).astype(np.float32)
+    out = ell_spmm(ell, b, chunk=64)
+    np.testing.assert_allclose(np.asarray(out), dense @ b, rtol=1e-3, atol=1e-3)
+
+
+def test_ell_overflow_residual():
+    # k_width smaller than the max row degree forces the BCOO residual path
+    m, n, p = 50, 30, 6
+    rows, cols, vals, dense = _random_coo(m, n, 400, 8)
+    ell = ell_from_coo(rows, cols, vals, (m, n), k_width=4)
+    assert ell.residual is not None
+    b = np.random.default_rng(9).standard_normal((n, p)).astype(np.float32)
+    out = ell_spmm(ell, b)
+    np.testing.assert_allclose(np.asarray(out), dense @ b, rtol=1e-3, atol=1e-3)
+
+
+def test_sparse_matrix_ell_path(mesh):
+    sp = mt.SparseVecMatrix.random(0, 300, 200, density=0.005, mesh=mesh)
+    dense = sp.to_numpy()
+    b = np.random.default_rng(10).standard_normal((200, 12)).astype(np.float32)
+    out_auto = sp.multiply(mt.BlockMatrix.from_array(b, mesh))  # auto -> ell
+    out_bcoo = sp.multiply(mt.BlockMatrix.from_array(b, mesh), format="bcoo")
+    np.testing.assert_allclose(out_auto.to_numpy(), dense @ b, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(out_bcoo.to_numpy(), dense @ b, rtol=1e-3, atol=1e-3)
+
+
+def test_symmetric_eigs_matrix_free(mesh):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    q, _ = np.linalg.qr(rng.standard_normal((60, 60)))
+    evals_true = np.linspace(30, 0.1, 60)
+    sym = (q * evals_true) @ q.T
+    sym_j = jnp.asarray(sym.astype(np.float32))
+    evals, vecs = mt.linalg.symmetric_eigs(lambda v: sym_j @ v, 60, k=5)
+    np.testing.assert_allclose(np.asarray(evals), evals_true[:5], rtol=1e-2)
+    # residual check ||Av - λv||
+    for i in range(5):
+        v = np.asarray(vecs[:, i])
+        resid = np.linalg.norm(sym @ v - float(evals[i]) * v)
+        assert resid < 0.1
+
+
+def test_dense_parity_fills(mesh):
+    rng = np.random.default_rng(12)
+    a = rng.standard_normal((10, 8)).astype(np.float32)
+    a[np.abs(a) < 0.8] = 0.0
+    m = mt.DenseVecMatrix.from_array(a, mesh)
+    sp = m.to_sparse_vec_matrix()
+    np.testing.assert_allclose(sp.to_numpy(), a)
+    # multiply_by: local @ distributed
+    local = rng.standard_normal((4, 10)).astype(np.float32)
+    out = m.multiply_by(local)
+    np.testing.assert_allclose(out.to_numpy(), local @ a, rtol=1e-4, atol=1e-4)
+    try:
+        import pandas  # noqa: F401
+
+        df = m.to_dataframe()
+        assert df.shape == (10, 8)
+    except ImportError:
+        pass

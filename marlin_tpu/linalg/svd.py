@@ -36,63 +36,95 @@ class SVDResult:
     v: np.ndarray  # right singular vectors, (n, k)
 
 
-def symmetric_eigs(matvec, n: int, k: int, num_iters: int | None = None,
-                   seed: int = 0, dtype=jnp.float32):
-    """Top-k eigenpairs of a symmetric operator given only ``v ↦ A·v`` — the
-    exact contract of the reference's ARPACK wrapper
-    (EigenValueDecomposition.symmetricEigs, DenseVecMatrix.scala:1743-1834),
-    with the reverse-communication loop replaced by a jitted Lanczos scan with
-    full (twice-iterated classical Gram-Schmidt) reorthogonalization.
-    ``matvec`` must be jax-traceable. Returns (eigenvalues desc, vectors (n, k))."""
-    cfg = get_config()
-    if num_iters is None:
-        num_iters = min(n, max(2 * k + 1, min(n, k * cfg.lanczos_max_iter_factor)))
-    num_iters = min(num_iters, n)
-    v0 = jax.random.normal(jax.random.key(seed), (n,), dtype)
+def _lanczos_scan(matvec, v0, iters: int):
+    """The Lanczos recurrence with twice-iterated classical Gram-Schmidt
+    reorthogonalization; traced inline by the jitted wrappers below."""
+    n = v0.shape[0]
+    q0 = v0 / jnp.linalg.norm(v0)
+    qs = jnp.zeros((iters + 1, n), v0.dtype).at[0].set(q0)
+
+    def body(carry, i):
+        qs, beta_prev = carry
+        q = qs[i]
+        w = matvec(q)
+        alpha = jnp.dot(w, q)
+        w = w - alpha * q - beta_prev * qs[i - 1] * (i > 0)
+        for _ in range(2):
+            w = w - qs.T @ (qs @ w)
+        beta = jnp.linalg.norm(w)
+        q_next = jnp.where(beta > 1e-12, w / jnp.maximum(beta, 1e-30),
+                           jnp.zeros_like(w))
+        qs = qs.at[i + 1].set(q_next)
+        return (qs, beta), (alpha, beta)
+
+    (qs, _), (alphas, betas) = jax.lax.scan(
+        body, (qs, jnp.zeros((), v0.dtype)), jnp.arange(iters)
+    )
+    return alphas, betas, qs
+
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def _gram_lanczos_run(a, v0, iters: int):
+    """Module-level jit: the AᵀA Lanczos compiles once per (shape, iters)."""
+
+    def matvec(v):
+        return jnp.dot(a.T, jnp.dot(a, v, precision="highest"), precision="highest")
+
+    return _lanczos_scan(matvec, v0, iters)
+
+
+@functools.lru_cache(maxsize=32)
+def _runner_for(matvec):
+    """Per-callable jitted runner: repeated calls with the *same function
+    object* reuse the compiled scan (a fresh lambda necessarily recompiles)."""
 
     @functools.partial(jax.jit, static_argnames=("iters",))
     def run(v0, iters):
-        q0 = v0 / jnp.linalg.norm(v0)
-        qs = jnp.zeros((iters + 1, n), v0.dtype).at[0].set(q0)
+        return _lanczos_scan(matvec, v0, iters)
 
-        def body(carry, i):
-            qs, beta_prev = carry
-            q = qs[i]
-            w = matvec(q)
-            alpha = jnp.dot(w, q)
-            w = w - alpha * q - beta_prev * qs[i - 1] * (i > 0)
-            for _ in range(2):
-                w = w - qs.T @ (qs @ w)
-            beta = jnp.linalg.norm(w)
-            q_next = jnp.where(beta > 1e-12, w / jnp.maximum(beta, 1e-30),
-                               jnp.zeros_like(w))
-            qs = qs.at[i + 1].set(q_next)
-            return (qs, beta), (alpha, beta)
+    return run
 
-        (qs, _), (alphas, betas) = jax.lax.scan(
-            body, (qs, jnp.zeros((), v0.dtype)), jnp.arange(iters)
-        )
-        return alphas, betas, qs
 
-    alphas, betas, qs = run(v0, num_iters)
+def _ritz_topk(alphas, betas, qs, k: int, num_iters: int):
     t = jnp.diag(alphas) + jnp.diag(betas[:-1], 1) + jnp.diag(betas[:-1], -1)
     evals, evecs = jnp.linalg.eigh(t)
     idx = jnp.argsort(-evals)[:k]
-    # Ritz vectors: Q[:iters].T @ evecs
     vecs = qs[:num_iters].T @ evecs[:, idx]
     vecs = vecs / jnp.maximum(jnp.linalg.norm(vecs, axis=0, keepdims=True), 1e-30)
     return evals[idx], vecs
 
 
+def _resolve_iters(n: int, k: int, num_iters: int | None) -> int:
+    cfg = get_config()
+    if num_iters is None:
+        num_iters = min(n, max(2 * k + 1, min(n, k * cfg.lanczos_max_iter_factor)))
+    return min(num_iters, n)
+
+
+def symmetric_eigs(matvec, n: int, k: int, num_iters: int | None = None,
+                   seed: int = 0, dtype=jnp.float32):
+    """Top-k eigenpairs of a symmetric operator given only ``v ↦ A·v`` — the
+    exact contract of the reference's ARPACK wrapper
+    (EigenValueDecomposition.symmetricEigs, DenseVecMatrix.scala:1743-1834),
+    with the reverse-communication loop replaced by a jitted Lanczos scan.
+    ``matvec`` must be jax-traceable; pass the same function object across
+    calls to reuse the compiled program. Returns (eigenvalues desc,
+    vectors (n, k))."""
+    num_iters = _resolve_iters(n, k, num_iters)
+    v0 = jax.random.normal(jax.random.key(seed), (n,), dtype)
+    alphas, betas, qs = _runner_for(matvec)(v0, num_iters)
+    return _ritz_topk(alphas, betas, qs, k, num_iters)
+
+
 def lanczos(a: jax.Array, k: int, num_iters: int | None = None, seed: int = 0):
-    """Top-k eigenpairs of AᵀA — the AᵀA specialization of
-    :func:`symmetric_eigs` used by the SVD path (the role of ARPACK
-    ``dsaupd``/``dseupd`` in the reference)."""
-
-    def matvec(v):
-        return jnp.dot(a.T, jnp.dot(a, v, precision="highest"), precision="highest")
-
-    return symmetric_eigs(matvec, a.shape[1], k, num_iters, seed, a.dtype)
+    """Top-k eigenpairs of AᵀA — the AᵀA specialization used by the SVD path
+    (the role of ARPACK ``dsaupd``/``dseupd`` in the reference). Compiles once
+    per (shape, iters) via a module-level jit."""
+    n = a.shape[1]
+    num_iters = _resolve_iters(n, k, num_iters)
+    v0 = jax.random.normal(jax.random.key(seed), (n,), a.dtype)
+    alphas, betas, qs = _gram_lanczos_run(a, v0, num_iters)
+    return _ritz_topk(alphas, betas, qs, k, num_iters)
 
 
 def compute_svd(mat, k: int, mode: str = "auto", compute_u: bool = True,

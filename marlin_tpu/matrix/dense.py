@@ -302,6 +302,27 @@ class DenseMatrix(DistributedMatrix):
         y = _matvec_jit(self.data, jnp.pad(v, (0, self.data.shape[1] - v.shape[0])))
         return DistributedVector.from_array(y[: self.num_rows()], self.mesh)
 
+    def multiply_gramian_by(self, v, precision: str | None = None):
+        """Matrix-free ``v ↦ AᵀA·v`` — the operator the reference hands to
+        ARPACK (DenseVecMatrix.multiplyGramianMatrixBy, DenseVecMatrix.scala:
+        1444-1459): one distributed aggregate per call there, one fused sharded
+        contraction here."""
+        from .vector import DistributedVector
+
+        vec = v.logical() if isinstance(v, DistributedVector) else jnp.asarray(v)
+        a = self.logical()
+        p = precision or get_config().matmul_precision
+        out = jnp.dot(a.T, jnp.dot(a, vec, precision=p), precision=p)
+        return DistributedVector.from_array(out, self.mesh)
+
+    def row_exchange(self, permutation):
+        """Apply a row permutation (the reference's rowExchange used to apply
+        accumulated LU pivots, DenseVecMatrix.scala:438-460)."""
+        perm = np.asarray(permutation)
+        if perm.shape[0] != self.num_rows():
+            raise ValueError("permutation length must equal the row count")
+        return self._wrap(self.logical()[jnp.asarray(perm)])
+
     def gramian(self, precision: str | None = None):
         """``AᵀA`` via one sharded contraction — replaces the treeAggregate-of-
         dspr formulation (DenseVecMatrix.computeGramianMatrix,
@@ -498,6 +519,12 @@ class BlockMatrix(DenseMatrix):
     @property
     def blocks_by_col(self) -> int:
         return self.mesh.shape.get(COLS, 1)
+
+    def to_dense_blocks(self) -> "BlockMatrix":
+        """Parity shim for BlockMatrix.toDenseBlocks (BlockMatrix.scala:596-603):
+        the reference converts sparse SubMatrix blocks to dense; blocks here are
+        always dense device tiles, so this is the identity."""
+        return self
 
 
 @jax.jit

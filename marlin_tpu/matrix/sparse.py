@@ -87,6 +87,13 @@ class CoordinateMatrix:
     def to_sparse_vec_matrix(self, mesh: Mesh | None = None) -> "SparseVecMatrix":
         return SparseVecMatrix(self.to_bcoo(), self._shape, mesh or self.mesh)
 
+    def to_block_matrix(self, mesh: Mesh | None = None):
+        """Densify straight into the 2-D block layout
+        (DenseVecMatrix.toBlockMatrixFromCoordinate, DenseVecMatrix.scala:1355-1379)."""
+        from .dense import BlockMatrix
+
+        return BlockMatrix.from_array(self.to_dense(), mesh or self.mesh)
+
     def to_numpy(self) -> np.ndarray:
         return np.asarray(jax.device_get(self.to_dense()))
 
@@ -179,21 +186,24 @@ class SparseVecMatrix:
         return BlockMatrix.from_array(out, self.mesh)
 
     def to_ell(self, k_width: int | None = None):
-        """Convert to ELL storage (cached). ``k_width=None`` caps the padded
-        row width at 4× the mean degree (min 8): a single dense hub row must
-        not inflate the (rows × K) arrays to dense-matrix size — overflow
-        entries go to the exact BCOO residual instead."""
-        if getattr(self, "_ell", None) is None:
+        """Convert to ELL storage, cached per k_width. ``k_width=None`` caps
+        the padded row width at 4× the mean degree (min 8): a single dense hub
+        row must not inflate the (rows × K) arrays to dense-matrix size —
+        overflow entries go to the exact BCOO residual instead."""
+        if k_width is None:
+            nnz = self.bcoo.nse
+            mean_deg = nnz / max(1, self._shape[0])
+            k_width = max(8, int(4 * mean_deg) + 1)
+        cache = getattr(self, "_ell_cache", None)
+        if cache is None:
+            cache = self._ell_cache = {}
+        if k_width not in cache:
             b = self.bcoo.sum_duplicates()
-            rows = np.asarray(b.indices[:, 0])
-            if k_width is None:
-                mean_deg = b.nse / max(1, self._shape[0])
-                k_width = max(8, int(4 * mean_deg) + 1)
-            self._ell = ell_from_coo(
-                rows, np.asarray(b.indices[:, 1]), np.asarray(b.data),
-                self._shape, k_width=k_width,
+            cache[k_width] = ell_from_coo(
+                np.asarray(b.indices[:, 0]), np.asarray(b.indices[:, 1]),
+                np.asarray(b.data), self._shape, k_width=k_width,
             )
-        return self._ell
+        return cache[k_width]
 
     def to_dense_vec_matrix(self, mesh: Mesh | None = None):
         """Densify (SparseVecMatrix.toDenseVecMatrix, SparseVecMatrix.scala:56-65)."""

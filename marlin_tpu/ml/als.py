@@ -93,14 +93,19 @@ def _solve_side_implicit(factors_other, seg_ids, other_ids, ratings, lam, alpha,
 
 def als_run(ratings, rank: int, iterations: int = 10, lam: float = 0.01,
             seed: int = 0, weighted_lambda: bool = True, mesh=None,
-            implicit_prefs: bool = False, alpha: float = 1.0) -> ALSModel:
+            implicit_prefs: bool = False, alpha: float = 1.0,
+            num_user_blocks: int = -1, num_product_blocks: int = -1) -> ALSModel:
     """Run blocked ALS (ALSHelp.ALSRun, ml/ALSHelp.scala:34-96).
 
     ``ratings`` is a CoordinateMatrix of (user, product, rating). Factors are
     initialized on the unit sphere like ``randomFactor`` (ALSHelp.scala:170-179).
     ``implicit_prefs``/``alpha`` select the implicit-feedback formulation, the
-    same switch ALSRun takes (ALSHelp.scala:33-34).
+    same switch ALSRun takes (ALSHelp.scala:33-34). ``num_user_blocks``/
+    ``num_product_blocks`` are accepted for signature parity but ignored:
+    blocking was the reference's shuffle-partitioning knob, and factor layout
+    here is governed by the mesh sharding instead.
     """
+    del num_user_blocks, num_product_blocks
     from ..matrix.dense import DenseVecMatrix
 
     mesh = mesh or ratings.mesh

@@ -25,7 +25,10 @@ def _load():
     global _lib, _tried_build
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO) and not _tried_build:
+    if not _tried_build:
+        # always let make decide — it no-ops when the .so is newer than the
+        # source, and rebuilds after a textio.cpp edit (a stale binary would
+        # silently shadow fixes otherwise)
         _tried_build = True
         try:
             subprocess.run(["make", "-s", "-C", _HERE],

@@ -67,10 +67,17 @@ int mt_count_matrix(const char* path, int64_t* rows, int64_t* cols) {
     const char* nl = static_cast<const char*>(std::memchr(p, '\n', end - p));
     const char* line_end = nl ? nl : end;
     const char* colon = static_cast<const char*>(std::memchr(p, ':', line_end - p));
-    if (colon) {
+    if (!colon) {
+      // only blank lines may lack the "rowIdx:" prefix — anything else is
+      // not this format (the Python parser raises there too)
+      for (const char* q = p; q < line_end; ++q) {
+        if (*q != ' ' && *q != '\t' && *q != '\r') return -EINVAL;
+      }
+    } else {
       char* after = nullptr;
       long long r = std::strtoll(p, &after, 10);
-      if (after && after <= colon) {
+      if (after == p || !after || after > colon) return -EINVAL;  // bad row idx
+      {
         if (r > max_row) max_row = r;
         // count values on every line: ragged inputs get the max width,
         // matching the Python parser's behavior. An unparseable token is a
@@ -106,10 +113,15 @@ int mt_load_matrix(const char* path, double* out, int64_t rows, int64_t cols) {
     const char* nl = static_cast<const char*>(std::memchr(p, '\n', end - p));
     const char* line_end = nl ? nl : end;
     const char* colon = static_cast<const char*>(std::memchr(p, ':', line_end - p));
-    if (colon) {
+    if (!colon) {
+      for (const char* q = p; q < line_end; ++q) {
+        if (*q != ' ' && *q != '\t' && *q != '\r') return -EINVAL;
+      }
+    } else {
       char* after = nullptr;
       long long r = std::strtoll(p, &after, 10);
-      if (after && after <= colon && r >= 0 && r < rows) {
+      if (after == p || !after || after > colon) return -EINVAL;
+      if (r >= 0 && r < rows) {
         double* row_out = out + r * cols;
         const char* q = colon + 1;
         int64_t j = 0;

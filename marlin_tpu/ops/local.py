@@ -69,6 +69,17 @@ def syrk(a: jax.Array, precision: str | None = None) -> jax.Array:
     return jnp.dot(a.T, a, precision=_precision(precision))
 
 
+def axpy(a: float, x: jax.Array, y: jax.Array) -> jax.Array:
+    """``y + a·x`` — the reference's vectMultiplyAdd (Vectors.scala)."""
+    return y + a * x
+
+
+def triu_to_full(u: jax.Array) -> jax.Array:
+    """Mirror an upper-triangular matrix into a full symmetric one
+    (DenseVecMatrix.triuToFull, DenseVecMatrix.scala:1705-1722)."""
+    return jnp.triu(u) + jnp.triu(u, 1).T
+
+
 def _to_bcoo(x) -> jsparse.BCOO:
     if isinstance(x, jsparse.BCOO):
         return x

@@ -1,4 +1,6 @@
 from .profiling import timer, evaluate, StepTimer, trace  # noqa: F401
+from .tracing import annotate, EventLog, matmul_flops, effective_gflops  # noqa: F401
+from .failure import ResilientLoop, heartbeat, NonFiniteLossError  # noqa: F401
 from .mtutils import (  # noqa: F401
     random_den_vec_matrix,
     random_block_matrix,

@@ -1,0 +1,69 @@
+"""Structured tracing/observability beyond wall-clock prints.
+
+The reference's observability is `System.currentTimeMillis` deltas and raw
+printlns (SURVEY.md §5.1/§5.5 — flagged as a gap worth exceeding). This module
+adds:
+
+- :func:`annotate` — names a region so it shows up in `jax.profiler` traces
+  (XProf/TensorBoard) as a labeled span.
+- :class:`EventLog` — append-only JSON-lines event log (step timings, bytes
+  moved, custom counters) for post-hoc analysis without a profiler UI.
+- :func:`matmul_flops` / :func:`effective_gflops` — the FLOP bookkeeping the
+  examples print, centralized.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any
+
+import jax
+
+__all__ = ["annotate", "EventLog", "matmul_flops", "effective_gflops"]
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Label a region in profiler traces; no-ops cheaply outside tracing."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def matmul_flops(m: int, k: int, n: int) -> float:
+    return 2.0 * m * k * n
+
+
+def effective_gflops(flops: float, seconds: float) -> float:
+    return flops / max(seconds, 1e-12) / 1e9
+
+
+class EventLog:
+    """JSON-lines event log: ``log.event("step", step=i, loss=x)``. Each line
+    carries a monotonic timestamp; flushes per event so crashes keep history
+    (this doubles as the post-mortem record for the failure subsystem)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a")
+
+    def event(self, kind: str, **fields: Any) -> None:
+        rec = {"t": time.time(), "kind": kind, **fields}
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    @contextlib.contextmanager
+    def timed(self, kind: str, **fields: Any):
+        t0 = time.perf_counter()
+        yield
+        self.event(kind, seconds=time.perf_counter() - t0, **fields)
+
+    def close(self) -> None:
+        self._f.close()
+
+    def read(self) -> list[dict]:
+        with open(self.path) as f:
+            return [json.loads(line) for line in f if line.strip()]

@@ -72,3 +72,24 @@ def test_native_corrupt_token_raises(tmp_path, lib_ok):
         f.write("0:1.0,2.0,x,4.0\n")
     with pytest.raises(ValueError):
         native.load_matrix_text(p)
+
+
+def test_native_bad_row_index_raises(tmp_path, lib_ok):
+    p = str(tmp_path / "badrow.txt")
+    with open(p, "w") as f:
+        f.write("0:1.0,2.0\nx:9.0,9.0\n1:3.0,4.0\n")
+    with pytest.raises(ValueError):
+        native.load_matrix_text(p)
+
+
+def test_native_colonless_line_raises(tmp_path, lib_ok):
+    p = str(tmp_path / "csv.txt")
+    with open(p, "w") as f:
+        f.write("1.0,2.0\n3.0,4.0\n")
+    with pytest.raises(ValueError):
+        native.load_matrix_text(p)
+    # blank lines are still fine
+    p2 = str(tmp_path / "blank.txt")
+    with open(p2, "w") as f:
+        f.write("0:1.0,2.0\n\n1:3.0,4.0\n")
+    np.testing.assert_allclose(native.load_matrix_text(p2), [[1, 2], [3, 4]])

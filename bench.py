@@ -19,8 +19,10 @@ import time
 
 import numpy as np
 
-N = int(os.environ.get("MARLIN_BENCH_N", "4000"))  # BASELINE config 2
-REPS = int(os.environ.get("MARLIN_BENCH_REPS", "30"))
+# BASELINE's north star names the 20000×20000 multiply (config 3); config 2
+# (4000) is available via MARLIN_BENCH_N=4000.
+N = int(os.environ.get("MARLIN_BENCH_N", "20000"))
+REPS = int(os.environ.get("MARLIN_BENCH_REPS", "5" if N >= 10000 else "30"))
 PRECISION = os.environ.get("MARLIN_BENCH_PRECISION", "high")  # f32-class accuracy
 
 
@@ -55,11 +57,20 @@ def tpu_gflops() -> float:
 
     c = a.multiply(b, precision=PRECISION)  # compile
     float(jnp.sum(c.data))
-    # correctness anchor vs f64 numpy on a slice
-    rows = np.asarray(c.data[:8]).astype(np.float64)
-    ref = a.to_numpy()[:8].astype(np.float64) @ b.to_numpy().astype(np.float64)
-    rel_err = np.abs(rows[:, :N] - ref).max() / np.abs(ref).max()
-    log(f"matmul rel err vs f64 numpy (precision={PRECISION}): {rel_err:.2e}")
+    # correctness anchor on a row slice: f64 numpy for small N; for large N the
+    # full operand D2H is impractical over the relay, so compare against an
+    # independent on-device f32-highest contraction instead
+    rows = np.asarray(c.data[:8]).astype(np.float64)[:, :N]
+    if N <= 4096:
+        ref = a.to_numpy()[:8].astype(np.float64) @ b.to_numpy().astype(np.float64)
+        anchor = "f64 numpy"
+    else:
+        ref = np.asarray(
+            jnp.dot(a.data[:8], b.data, precision="highest")
+        ).astype(np.float64)[:, :N]
+        anchor = "on-device f32-highest"
+    rel_err = np.abs(rows - ref).max() / np.abs(ref).max()
+    log(f"matmul rel err vs {anchor} (precision={PRECISION}): {rel_err:.2e}")
 
     # enqueue REPS multiplies, force completion once with a scalar fetch
     t0 = time.perf_counter()

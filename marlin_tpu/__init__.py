@@ -36,7 +36,7 @@ from .matrix import (  # noqa: F401
     DistributedVector,
     SparseVecMatrix,
 )
-from .parallel import matmul, rmm_matmul, split_method  # noqa: F401
+from .parallel import matmul, ring_attention, ring_matmul, rmm_matmul, split_method  # noqa: F401
 from .linalg import cholesky_decompose, compute_svd, inverse, lanczos, lu_decompose  # noqa: F401
 from .io import (  # noqa: F401
     load_block_matrix_file,

@@ -77,12 +77,18 @@ class DenseMatrix(DistributedMatrix):
         if arr.ndim != 2:
             raise ValueError(f"expected a 2-D array, got shape {arr.shape}")
         m, n = arr.shape
+        if m == 0 or n == 0:
+            # parity with the reference's empty-RDD IllegalArgumentException
+            # (DistributedMatrixSuite.scala:53-71)
+            raise ValueError(f"cannot build a distributed matrix with shape {arr.shape}")
         gr, gc = _grid_divisors(mesh, spec)
         mp, np_ = pad_to_multiple(m, gr), pad_to_multiple(n, gc)
         if (mp, np_) != (m, n):
             arr = jnp.pad(arr, ((0, mp - m), (0, np_ - n)))
-        data = jax.device_put(arr, NamedSharding(mesh, spec))
-        return cls(data, (m, n), mesh, spec)
+        sharding = NamedSharding(mesh, spec)
+        if not (isinstance(arr, jax.Array) and arr.sharding == sharding):
+            arr = jax.device_put(arr, sharding)
+        return cls(arr, (m, n), mesh, spec)
 
     @classmethod
     def random(

@@ -47,19 +47,61 @@ class ALSModel:
         return float(jnp.sqrt(jnp.mean(err * err)))
 
 
+def _chunked_segment_stats(factors_other, seg_ids, other_ids, ratings,
+                           num_segments, weight=None, chunk: int | None = None):
+    """Accumulate per-segment XᵀX / Xᵀy / counts over nnz in bounded chunks:
+    the (chunk, rank, rank) outer-product tensor never materializes beyond a
+    fixed element budget, so huge rating sets (the MEMORY_AND_DISK link tables
+    of the reference, ALSHelp.scala:32) stay in HBM."""
+    nnz = ratings.shape[0]
+    rank = factors_other.shape[1]
+    if chunk is None:
+        # ~64 MB f32 of outer-product tensor per chunk regardless of rank
+        chunk = max(1, (1 << 24) // (rank * rank))
+    chunk = max(1, min(chunk, nnz))
+    n_chunks = max(1, -(-nnz // chunk))
+    pad = n_chunks * chunk - nnz
+    if pad:
+        seg_ids = jnp.pad(seg_ids, (0, pad), constant_values=num_segments)
+        other_ids = jnp.pad(other_ids, (0, pad))
+        ratings = jnp.pad(ratings, (0, pad))
+        if weight is not None:
+            weight = jnp.pad(weight, (0, pad))
+    dt = factors_other.dtype
+
+    def body(carry, idx):
+        xtx, xty, counts = carry
+        s = seg_ids[idx]
+        vt = factors_other[other_ids[idx]]
+        r = ratings[idx]
+        w = weight[idx] if weight is not None else jnp.ones_like(r)
+        outer = vt[:, :, None] * vt[:, None, :] * w[:, None, None]
+        # the extra segment (num_segments) swallows the padding entries
+        xtx = xtx + jax.ops.segment_sum(outer, s, num_segments + 1)
+        xty = xty + jax.ops.segment_sum(vt * r[:, None], s, num_segments + 1)
+        counts = counts + jax.ops.segment_sum(jnp.ones_like(r), s, num_segments + 1)
+        return (xtx, xty, counts), None
+
+    init = (
+        jnp.zeros((num_segments + 1, rank, rank), dt),
+        jnp.zeros((num_segments + 1, rank), dt),
+        jnp.zeros((num_segments + 1,), dt),
+    )
+    idxs = jnp.arange(n_chunks * chunk).reshape(n_chunks, chunk)
+    (xtx, xty, counts), _ = jax.lax.scan(body, init, idxs)
+    return xtx[:num_segments], xty[:num_segments], counts[:num_segments]
+
+
 @functools.partial(jax.jit, static_argnames=("num_segments", "weighted"))
 def _solve_side(factors_other, seg_ids, other_ids, ratings, rank, lam,
                 num_segments, weighted):
     """One explicit half-step: recompute `num_segments` factor rows from the
     fixed other side. seg_ids: which row each rating belongs to; other_ids:
-    which fixed factor it references."""
-    vt = factors_other[other_ids]  # (nnz, rank) gathered
-    # per-rating normal-equation contributions (the vectorized dspr loop,
-    # ALSHelp.scala:292-382)
-    outer = vt[:, :, None] * vt[:, None, :]  # (nnz, rank, rank)
-    xtx = jax.ops.segment_sum(outer, seg_ids, num_segments)
-    xty = jax.ops.segment_sum(vt * ratings[:, None], seg_ids, num_segments)
-    counts = jax.ops.segment_sum(jnp.ones_like(ratings), seg_ids, num_segments)
+    which fixed factor it references. Normal-equation stats accumulate in
+    nnz chunks (the vectorized dspr loop, ALSHelp.scala:292-382)."""
+    xtx, xty, counts = _chunked_segment_stats(
+        factors_other, seg_ids, other_ids, ratings, num_segments
+    )
     reg = lam * (counts[:, None] if weighted else jnp.ones_like(counts)[:, None])
     eye = jnp.eye(xtx.shape[-1], dtype=xtx.dtype)
     a = xtx + reg[:, :, None] * eye
@@ -77,14 +119,13 @@ def _solve_side_implicit(factors_other, seg_ids, other_ids, ratings, lam, alpha,
     ALSHelp.scala:188-200, 292-382): solve
     (YᵀY + Yᵀ(C−I)Y + λI) x = Yᵀ C p  per row, with the dense YᵀY computed
     once globally and only the (c−1)-weighted corrections segment-summed."""
-    vt = factors_other[other_ids]  # (nnz, rank)
     yty = jnp.dot(factors_other.T, factors_other, precision="highest")
     conf_minus_1 = alpha * ratings  # c = 1 + alpha*r
-    outer = vt[:, :, None] * vt[:, None, :] * conf_minus_1[:, None, None]
-    corr = jax.ops.segment_sum(outer, seg_ids, num_segments)
-    # preference p = 1 for observed entries; rhs = Σ c·p·v
-    rhs = jax.ops.segment_sum(vt * (1.0 + conf_minus_1)[:, None], seg_ids, num_segments)
-    counts = jax.ops.segment_sum(jnp.ones_like(ratings), seg_ids, num_segments)
+    # chunked accumulation: corr = Σ (c−1)·v vᵀ, rhs = Σ c·p·v (p = 1 observed)
+    corr, rhs, counts = _chunked_segment_stats(
+        factors_other, seg_ids, other_ids, 1.0 + conf_minus_1,
+        num_segments, weight=conf_minus_1,
+    )
     eye = jnp.eye(yty.shape[0], dtype=yty.dtype)
     a = yty[None] + corr + lam * eye[None]
     sol = jnp.linalg.solve(a, rhs[..., None])[..., 0]

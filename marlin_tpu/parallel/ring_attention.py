@@ -1,0 +1,140 @@
+"""Ring attention: exact attention over sequences sharded across the mesh.
+
+The reference has no attention (SURVEY.md §2.7 "not present"), but its answer
+to "a dimension too big for one node" — split it, rotate partial operands,
+accumulate (the k-split RMM) — extends naturally to attention, and the task's
+long-context requirement makes it first-class here. This is the blockwise-
+softmax formulation (flash-attention style numerically-stable running max /
+denominator), with K/V panels rotating around the device ring via
+``lax.ppermute`` exactly like :mod:`marlin_tpu.parallel.ring`'s B-panels:
+every device keeps its Q rows stationary, sees each K/V panel once, and the
+DMA for panel i+1 overlaps the softmax·V math for panel i. Communication per
+step is O(seq/p · d) on ICI; memory per device never exceeds the local panel
+— sequences scale linearly with the ring size.
+
+Masking uses global positions (the Q block index is the device's mesh
+coordinate; the K block owner is tracked through the rotation), so the sharded
+result — causal or not, padded or not — is the single-device result exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..mesh import ROWS, default_mesh, pad_to_multiple
+
+__all__ = ["ring_attention", "attention_reference"]
+
+_NEG = -1e30
+
+
+def attention_reference(q, k, v, causal: bool = False, scale: float | None = None):
+    """Single-device oracle: softmax(q kᵀ · scale) v. Pinned to highest
+    precision — an oracle that silently drops to bf16 on TPU would misreport
+    kernel error."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("...qd,...kd->...qk", q, k, precision="highest") * scale
+    if causal:
+        qlen, klen = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(qlen)[:, None] >= jnp.arange(klen)[None, :]
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p, v, precision="highest")
+
+
+@functools.lru_cache(maxsize=32)
+def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float):
+    """One kernel covers all cases: ``valid_len`` masks padded key positions
+    (a no-op when the sequence fills the padded length), and ``causal`` adds
+    the triangular mask on top."""
+    p_size = mesh.shape[axis]
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+    def local(q_blk, k_blk, v_blk, valid_len):
+        # q_blk: (sq, d) stationary; k_blk/v_blk: (skv, d) rotating
+        sq, d = q_blk.shape
+        skv = k_blk.shape[0]
+        idx = jax.lax.axis_index(axis)
+        q_pos = idx * sq + jnp.arange(sq)
+
+        def step(i, carry):
+            k_cur, v_cur, m, l, acc = carry
+            owner = (idx - i) % p_size
+            k_next = jax.lax.ppermute(k_cur, axis, perm)
+            v_next = jax.lax.ppermute(v_cur, axis, perm)
+            s = jnp.dot(q_blk, k_cur.T, precision="highest",
+                        preferred_element_type=jnp.float32) * scale
+            k_pos = owner * skv + jnp.arange(skv)
+            keep = k_pos[None, :] < valid_len
+            if causal:
+                keep = keep & (q_pos[:, None] >= k_pos[None, :])
+            s = jnp.where(keep, s, _NEG)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p_ = jnp.exp(s - m_new[:, None])
+            l = l * alpha + jnp.sum(p_, axis=-1)
+            acc = acc * alpha[:, None] + jnp.dot(
+                p_, v_cur.astype(jnp.float32), precision="highest"
+            )
+            return k_next, v_next, m_new, l, acc
+
+        m0 = jax.lax.pcast(jnp.full((sq,), _NEG, jnp.float32), (axis,), to="varying")
+        l0 = jax.lax.pcast(jnp.zeros((sq,), jnp.float32), (axis,), to="varying")
+        acc0 = jax.lax.pcast(jnp.zeros((sq, d), jnp.float32), (axis,), to="varying")
+        _, _, m, l, acc = jax.lax.fori_loop(
+            0, p_size, step, (k_blk, v_blk, m0, l0, acc0)
+        )
+        return (acc / jnp.maximum(l, 1e-30)[:, None]).astype(q_blk.dtype)
+
+    @jax.jit
+    def f(q, k, v, valid_len):
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(axis, None), P(axis, None), P()),
+            out_specs=P(axis, None),
+        )(q, k, v, valid_len)
+
+    return f
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh | None = None,
+    axis: str = ROWS,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Exact attention with the sequence sharded over ``axis``.
+
+    ``q``/``k``/``v``: (seq, d), or (heads, seq, d) for multi-head (vmapped
+    over heads). Sequence lengths are padded to the ring size; padded key
+    positions are masked out of the softmax exactly."""
+    if q.ndim == 3:
+        fn = jax.vmap(lambda qh, kh, vh: ring_attention(
+            qh, kh, vh, mesh, axis, causal, scale))
+        return fn(q, k, v)
+    seq, d = q.shape
+    if k.shape != (seq, d) or v.shape != (seq, d):
+        raise ValueError(f"q/k/v shape mismatch: {q.shape} {k.shape} {v.shape}")
+    mesh = mesh or default_mesh()
+    p_size = mesh.shape[axis]
+    sp = pad_to_multiple(seq, p_size)
+    if sp != seq:
+        q = jnp.pad(q, ((0, sp - seq), (0, 0)))
+        k = jnp.pad(k, ((0, sp - seq), (0, 0)))
+        v = jnp.pad(v, ((0, sp - seq), (0, 0)))
+    scale_val = float(scale if scale is not None else 1.0 / math.sqrt(d))
+    sh = NamedSharding(mesh, P(axis, None))
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+    out = _ring_attn_fn(mesh, axis, causal, scale_val)(
+        q, k, v, jnp.asarray(seq, jnp.int32)
+    )
+    return out[:seq] if sp != seq else out

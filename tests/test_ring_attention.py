@@ -1,0 +1,61 @@
+"""Ring attention vs the dense single-device oracle."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from marlin_tpu.parallel.ring_attention import attention_reference, ring_attention
+
+
+def _qkv(seq, d, seed, heads=None):
+    rng = np.random.default_rng(seed)
+    shape = (seq, d) if heads is None else (heads, seq, d)
+    return tuple(jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+                 for _ in range(3))
+
+
+def test_ring_attention_matches_dense(mesh):
+    q, k, v = _qkv(64, 32, 0)
+    out = ring_attention(q, k, v, mesh)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_causal(mesh):
+    q, k, v = _qkv(64, 16, 1)
+    out = ring_attention(q, k, v, mesh, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_uneven_seq(mesh):
+    # 51 is odd — not divisible by the ring axis (size 2), so the pad/mask
+    # paths genuinely run
+    q, k, v = _qkv(51, 16, 2)
+    out = ring_attention(q, k, v, mesh)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+    out_c = ring_attention(q, k, v, mesh, causal=True)
+    ref_c = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out_c), np.asarray(ref_c), rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_multihead(mesh):
+    q, k, v = _qkv(32, 8, 3, heads=4)
+    out = ring_attention(q, k, v, mesh, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_custom_scale(mesh):
+    q, k, v = _qkv(16, 8, 4)
+    out = ring_attention(q, k, v, mesh, scale=0.1)
+    ref = attention_reference(q, k, v, scale=0.1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_shape_mismatch(mesh):
+    q, k, v = _qkv(16, 8, 5)
+    with pytest.raises(ValueError):
+        ring_attention(q, k[:8], v, mesh)

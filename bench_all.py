@@ -4,12 +4,16 @@ Writes one JSON object per config to stdout (one per line) and a summary table
 to BENCHMARKS.md. ``bench.py`` remains the single-line headline driver; this
 is the RMMcompare-style wider harness.
 
-Configs (BASELINE.md):
+Configs (BASELINE.md) — the default sweep runs 1-5; the extras run only when
+named (``python bench_all.py lu chol attn``) because they are additions beyond
+the BASELINE config list:
   1. 100×100 file-based multiply (genmat data), CPU-comparable
   2. 4000×4000 dense multiply, single chip
   3. 20000×20000 dense multiply
-  4. tall-skinny 10⁷×512 Gramian, host-streamed (out-of-core)
+  4. tall-skinny ×512 Gramian, host-streamed (out-of-core)
   5. sparse 10⁶×10⁶ @ 1e-4 density × dense 10⁶×256 (ELL SpMM)
+  lu / chol: 8192² distributed blocked factorizations
+  attn: 32768×128 causal ring attention
 """
 
 import json
@@ -140,6 +144,64 @@ def config5():
            f"{dt * 1e3:.0f} ms, ELL K={ell.k_width}")
 
 
+def config_lu(n=8192):
+    import jax.numpy as jnp
+
+    import marlin_tpu as mt
+
+    mesh = mt.create_mesh()
+    base = mt.BlockMatrix.random(0, n, n, mesh=mesh)
+    a = base.add(mt.BlockMatrix.from_array(float(n) * np.eye(n, dtype=np.float32), mesh))
+    float(jnp.sum(a.data))
+    l, u, p = a.lu_decompose(mode="dist", )
+    float(jnp.sum(l.data) + jnp.sum(u.data))  # compile + materialize
+    t0 = time.perf_counter()
+    l, u, p = a.lu_decompose(mode="dist")
+    float(jnp.sum(l.data) + jnp.sum(u.data))
+    dt = time.perf_counter() - t0
+    record(f"lu_dist_{n}", (2 / 3) * n**3 / dt / 1e9, "GFLOP/s", f"{dt:.2f} s")
+
+
+def config_cholesky(n=8192):
+    import jax.numpy as jnp
+
+    import marlin_tpu as mt
+
+    mesh = mt.create_mesh()
+    r = mt.BlockMatrix.random(0, n, n, mesh=mesh)
+    a = r.multiply(r.transpose(), precision="high").add(
+        mt.BlockMatrix.from_array(float(n) * np.eye(n, dtype=np.float32), mesh)
+    )
+    float(jnp.sum(a.data))
+    l = a.cholesky_decompose(mode="dist")
+    float(jnp.sum(l.data))
+    t0 = time.perf_counter()
+    l = a.cholesky_decompose(mode="dist")
+    float(jnp.sum(l.data))
+    dt = time.perf_counter() - t0
+    record(f"cholesky_dist_{n}", (1 / 3) * n**3 / dt / 1e9, "GFLOP/s", f"{dt:.2f} s")
+
+
+def config_attention(seq=32768, d=128):
+    import jax.numpy as jnp
+
+    import marlin_tpu as mt
+
+    mesh = mt.create_mesh()
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((seq, d)).astype(np.float32))
+               for _ in range(3))
+    out = mt.ring_attention(q, k, v, mesh, causal=True)
+    float(jnp.sum(out))
+    t0 = time.perf_counter()
+    out = mt.ring_attention(q, k, v, mesh, causal=True)
+    float(jnp.sum(out))
+    dt = time.perf_counter() - t0
+    flops = 2.0 * seq * seq * d  # causal: qk^T + pv, halved by the mask
+    record(f"ring_attention_{seq}x{d}", flops / dt / 1e9, "GFLOP/s",
+           f"{dt * 1e3:.0f} ms causal")
+
+
 def main():
     which = sys.argv[1:] or ["1", "2", "3", "4", "5"]
     steps = {
@@ -148,6 +210,9 @@ def main():
         "3": lambda: _dense_config(20000, 5, "3_dense_20000"),
         "4": config4,
         "5": config5,
+        "lu": config_lu,
+        "chol": config_cholesky,
+        "attn": config_attention,
     }
     for k in which:
         log(f"=== config {k}")

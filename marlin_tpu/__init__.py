@@ -34,6 +34,7 @@ from .matrix import (  # noqa: F401
     DistributedIntVector,
     DistributedMatrix,
     DistributedVector,
+    OutOfCoreMatrix,
     SparseVecMatrix,
 )
 from .parallel import matmul, ring_attention, ring_matmul, rmm_matmul, split_method  # noqa: F401

@@ -2,3 +2,4 @@ from .base import DistributedMatrix  # noqa: F401
 from .dense import DenseMatrix, DenseVecMatrix, BlockMatrix  # noqa: F401
 from .vector import DistributedVector, DistributedIntVector  # noqa: F401
 from .sparse import SparseVecMatrix, CoordinateMatrix  # noqa: F401
+from .out_of_core import OutOfCoreMatrix  # noqa: F401

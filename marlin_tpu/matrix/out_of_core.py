@@ -1,0 +1,120 @@
+"""Host-resident matrices bigger than device HBM.
+
+The reference handles oversized matrices by letting Spark spill RDD partitions
+to disk (MEMORY_AND_DISK persistence, SURVEY.md §7 hard parts). The TPU-native
+equivalent is an explicit host-resident type whose operations stream row
+chunks through the device (marlin_tpu.parallel.streaming): ``OutOfCoreMatrix``
+wraps a numpy array, ``np.memmap``, or a chunk-producing callable and exposes
+the subset of the DenseMatrix API whose algorithms admit a streaming form —
+multiply by a device-resident right-hand side, Gramian, sum, row slicing, and
+conversion to an in-HBM matrix when it fits.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from ..parallel.streaming import iter_row_chunks, streamed_gramian, streamed_matmul
+
+__all__ = ["OutOfCoreMatrix"]
+
+
+class OutOfCoreMatrix:
+    def __init__(self, source, shape: tuple[int, int] | None = None,
+                 chunk_rows: int = 1 << 18):
+        """``source``: a 2-D ndarray/memmap, or a zero-arg callable returning a
+        fresh iterator of row-chunk ndarrays (callables must be re-iterable so
+        multiple operations can each make a full pass)."""
+        if callable(source):
+            if shape is None:
+                raise ValueError("shape is required for a callable chunk source")
+            self._source = source
+            self._shape = tuple(shape)
+        else:
+            arr = source
+            if arr.ndim != 2:
+                raise ValueError(f"expected 2-D source, got shape {arr.shape}")
+            if shape is not None and tuple(shape) != tuple(arr.shape):
+                raise ValueError(
+                    f"shape {tuple(shape)} contradicts the array's {tuple(arr.shape)}"
+                )
+            self._source = None
+            self._array = arr
+            self._shape = tuple(arr.shape)
+        self.chunk_rows = chunk_rows
+
+    # ------------------------------------------------------------- structure
+    def num_rows(self) -> int:
+        return self._shape[0]
+
+    def num_cols(self) -> int:
+        return self._shape[1]
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return self._shape
+
+    def _chunks(self) -> Iterator[np.ndarray]:
+        if self._source is not None:
+            return iter(self._source())
+        return iter_row_chunks(self._array, self.chunk_rows)
+
+    # ------------------------------------------------------------ operations
+    def multiply(self, other, out: np.ndarray | None = None,
+                 precision: str | None = None) -> np.ndarray | None:
+        """``self @ other`` with ``other`` resident on device; the result
+        streams back to host (or into ``out``, e.g. a writable memmap)."""
+        other_arr = other.logical() if hasattr(other, "logical") else np.asarray(other)
+        if other_arr.shape[0] != self.num_cols():
+            raise ValueError(
+                f"inner dim mismatch: {self.shape} @ {tuple(other_arr.shape)}"
+            )
+        # _chunks() already yields chunk_rows-sized pieces; streamed_* consume
+        # the iterator as-is
+        return streamed_matmul(self._chunks(), other_arr, out=out,
+                               precision=precision)
+
+    def gramian(self, precision: str | None = None) -> np.ndarray:
+        """``AᵀA`` with the n×n accumulator on device."""
+        return streamed_gramian(self._chunks(), precision=precision)
+
+    def sum(self) -> float:
+        return float(sum(np.sum(c, dtype=np.float64) for c in self._chunks()))
+
+    def slice_rows(self, start: int, stop: int) -> np.ndarray:
+        """Materialize a host row range [start, stop)."""
+        if self._source is None:
+            return np.asarray(self._array[start:stop])
+        out, pos = [], 0
+        for c in self._chunks():
+            lo, hi = max(start - pos, 0), min(stop - pos, c.shape[0])
+            if lo < hi:
+                out.append(np.asarray(c[lo:hi]))
+            pos += c.shape[0]
+            if pos >= stop:
+                break
+        return np.concatenate(out, axis=0) if out else np.zeros((0, self.num_cols()))
+
+    def to_dense_vec_matrix(self, mesh=None):
+        """Load fully into HBM (only when it fits)."""
+        from .dense import DenseVecMatrix
+
+        if self._source is None:
+            return DenseVecMatrix.from_array(self._array, mesh)
+        # fill a single preallocated buffer — buffering all chunks and
+        # concatenating would need 2x the matrix in host RAM
+        first = next(iter(self._chunks()))
+        buf = np.empty(self._shape, first.dtype)
+        pos = 0
+        for c in self._chunks():
+            buf[pos : pos + c.shape[0]] = c
+            pos += c.shape[0]
+        if pos != self._shape[0]:
+            raise ValueError(f"chunk source yielded {pos} rows, expected {self._shape[0]}")
+        return DenseVecMatrix.from_array(buf, mesh)
+
+    def __repr__(self):
+        kind = "callable" if self._source is not None else type(self._array).__name__
+        return f"OutOfCoreMatrix(shape={self._shape}, source={kind}, chunk_rows={self.chunk_rows})"

@@ -65,15 +65,19 @@ def mlp_loss(params: dict, x: jax.Array, y: jax.Array) -> jax.Array:
     return 0.5 * jnp.mean(jnp.sum((out - y) ** 2, axis=-1))
 
 
-@functools.partial(jax.jit, static_argnames=("batch_size", "lr"))
-def train_step(params, x, y, key, batch_size: int, lr: float):
-    """One SPMD step: strided batch sample + grad + SGD update."""
+@functools.partial(jax.jit, static_argnames=("batch_size", "lr", "remat"))
+def train_step(params, x, y, key, batch_size: int, lr: float, remat: bool = False):
+    """One SPMD step: strided batch sample + grad + SGD update. ``remat=True``
+    rematerializes the forward in the backward pass (``jax.checkpoint``) —
+    trading FLOPs for activation memory, the knob for models/batches near the
+    HBM limit."""
     m = x.shape[0]
     stride = max(1, m // batch_size)
     offset = jax.random.randint(key, (), 0, m)
     idx = (offset + jnp.arange(batch_size) * stride) % m
     xb, yb = x[idx], y[idx]
-    loss, grads = jax.value_and_grad(mlp_loss)(params, xb, yb)
+    loss_fn = jax.checkpoint(mlp_loss) if remat else mlp_loss
+    loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
     new_params = jax.tree.map(lambda w, g: w - lr * g, params, grads)
     return new_params, loss
 
@@ -89,6 +93,7 @@ class NeuralNetwork:
     output_dim: int = 10
     learning_rate: float = 0.5
     seed: int = 0
+    remat: bool = False  # jax.checkpoint the forward (memory for FLOPs)
 
     def init_params(self, mesh=None, dtype=jnp.float32) -> dict:
         mesh = mesh or default_mesh()
@@ -136,7 +141,7 @@ class NeuralNetwork:
         for it in range(iterations):
             key, sub = jax.random.split(key)
             params, loss = train_step(
-                params, x, y, sub, batch_size, self.learning_rate
+                params, x, y, sub, batch_size, self.learning_rate, self.remat
             )
             if log_every and (it + 1) % log_every == 0:
                 print(f"iter {it + 1}: loss {float(loss):.6f}")

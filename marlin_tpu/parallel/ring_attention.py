@@ -47,11 +47,17 @@ def attention_reference(q, k, v, causal: bool = False, scale: float | None = Non
     return jnp.einsum("...qk,...kd->...qd", p, v, precision="highest")
 
 
+_KV_TILE = 2048  # inner tile bounding the (sq × tile) score buffer
+
+
 @functools.lru_cache(maxsize=32)
 def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float):
     """One kernel covers all cases: ``valid_len`` masks padded key positions
     (a no-op when the sequence fills the padded length), and ``causal`` adds
-    the triangular mask on top."""
+    the triangular mask on top. Within each ring step the resident K/V panel
+    is processed in fixed KV tiles, so per-device score memory is
+    O(seq/p · tile) instead of O((seq/p)²) — long sequences on small rings
+    (including ring size 1) stay in HBM."""
     p_size = mesh.shape[axis]
     perm = [(j, (j + 1) % p_size) for j in range(p_size)]
 
@@ -59,17 +65,20 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float):
         # q_blk: (sq, d) stationary; k_blk/v_blk: (skv, d) rotating
         sq, d = q_blk.shape
         skv = k_blk.shape[0]
+        # the caller pads so that skv > _KV_TILE implies _KV_TILE | skv
+        tile = _KV_TILE if skv % _KV_TILE == 0 else skv
+        n_tiles = skv // tile
         idx = jax.lax.axis_index(axis)
         q_pos = idx * sq + jnp.arange(sq)
 
-        def step(i, carry):
-            k_cur, v_cur, m, l, acc = carry
-            owner = (idx - i) % p_size
-            k_next = jax.lax.ppermute(k_cur, axis, perm)
-            v_next = jax.lax.ppermute(v_cur, axis, perm)
-            s = jnp.dot(q_blk, k_cur.T, precision="highest",
+        def accumulate_tile(t, carry, k_cur, v_cur, owner):
+            m, l, acc = carry
+            off = t * tile
+            k_t = jax.lax.dynamic_slice(k_cur, (off, 0), (tile, d))
+            v_t = jax.lax.dynamic_slice(v_cur, (off, 0), (tile, d))
+            s = jnp.dot(q_blk, k_t.T, precision="highest",
                         preferred_element_type=jnp.float32) * scale
-            k_pos = owner * skv + jnp.arange(skv)
+            k_pos = owner * skv + off + jnp.arange(tile)
             keep = k_pos[None, :] < valid_len
             if causal:
                 keep = keep & (q_pos[:, None] >= k_pos[None, :])
@@ -79,9 +88,21 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float):
             p_ = jnp.exp(s - m_new[:, None])
             l = l * alpha + jnp.sum(p_, axis=-1)
             acc = acc * alpha[:, None] + jnp.dot(
-                p_, v_cur.astype(jnp.float32), precision="highest"
+                p_, v_t.astype(jnp.float32), precision="highest"
             )
-            return k_next, v_next, m_new, l, acc
+            return m_new, l, acc
+
+        def step(i, carry):
+            k_cur, v_cur, m, l, acc = carry
+            owner = (idx - i) % p_size
+            k_next = jax.lax.ppermute(k_cur, axis, perm)
+            v_next = jax.lax.ppermute(v_cur, axis, perm)
+            m, l, acc = jax.lax.fori_loop(
+                0, n_tiles,
+                lambda t, c: accumulate_tile(t, c, k_cur, v_cur, owner),
+                (m, l, acc),
+            )
+            return k_next, v_next, m, l, acc
 
         m0 = jax.lax.pcast(jnp.full((sq,), _NEG, jnp.float32), (axis,), to="varying")
         l0 = jax.lax.pcast(jnp.zeros((sq,), jnp.float32), (axis,), to="varying")
@@ -127,6 +148,11 @@ def ring_attention(
     mesh = mesh or default_mesh()
     p_size = mesh.shape[axis]
     sp = pad_to_multiple(seq, p_size)
+    if sp // p_size > _KV_TILE:
+        # pad so each device's panel is a whole number of KV tiles — the
+        # memory bound (sq × _KV_TILE scores) must hold for ANY length, and
+        # valid_len masks the padded keys exactly
+        sp = p_size * pad_to_multiple(sp // p_size, _KV_TILE)
     if sp != seq:
         q = jnp.pad(q, ((0, sp - seq), (0, 0)))
         k = jnp.pad(k, ((0, sp - seq), (0, 0)))

@@ -59,3 +59,16 @@ def test_ring_attention_shape_mismatch(mesh):
     q, k, v = _qkv(16, 8, 5)
     with pytest.raises(ValueError):
         ring_attention(q, k[:8], v, mesh)
+
+
+def test_ring_attention_tile_padding(mesh):
+    # seq just over ring*KV_TILE forces the tile-multiple padding path
+    import importlib
+
+    ra = importlib.import_module("marlin_tpu.parallel.ring_attention")
+
+    seq = 2 * ra._KV_TILE + 3  # ring axis size 2 -> skv > _KV_TILE
+    q, k, v = _qkv(seq, 8, 6)
+    out = ra.ring_attention(q, k, v, mesh, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=3e-4, atol=3e-4)

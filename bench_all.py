@@ -134,10 +134,10 @@ def config5():
     import jax.numpy as jnp
 
     b_dev = jnp.asarray(b)
-    out = ell_spmm(ell, b_dev, chunk=2048)
+    out = ell_spmm(ell, b_dev)
     sync(out)
     t0 = time.perf_counter()
-    out = ell_spmm(ell, b_dev, chunk=2048)
+    out = ell_spmm(ell, b_dev)
     sync(out)
     dt = time.perf_counter() - t0
     record("5_spmm_1e6_1e-4_x256", 2 * nnz * p / dt / 1e9, "GFLOP/s",

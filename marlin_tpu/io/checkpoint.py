@@ -87,11 +87,30 @@ def save_checkpoint(state, path: str, step: int) -> None:
 
 def load_checkpoint(state_like, path: str, step: int | None = None):
     """Restore a checkpoint into the structure of ``state_like``.
-    Returns (state, step). ``step=None`` loads the latest."""
+    Returns (state, step). ``step=None`` loads the latest.
+
+    ``state_like`` is a real template, not just a treedef: restored leaves
+    must match its shapes/dtypes (a mismatch means the checkpoint belongs to a
+    different model configuration — error, never silently swap architectures),
+    and each leaf is re-placed onto the template leaf's sharding so
+    tensor/data-parallel placements survive the restore."""
     if step is None:
         with open(os.path.join(path, "latest")) as f:
             step = int(f.read().strip())
     data = np.load(os.path.join(path, f"ckpt_{step:08d}.npz"))
     leaves, treedef = jax.tree.flatten(state_like)
-    new_leaves = [jax.numpy.asarray(data[f"leaf_{i}"]) for i in range(len(leaves))]
+    new_leaves = []
+    for i, tmpl in enumerate(leaves):
+        arr = data[f"leaf_{i}"]
+        tmpl_shape = tuple(getattr(tmpl, "shape", arr.shape))
+        if tuple(arr.shape) != tmpl_shape:
+            raise ValueError(
+                f"checkpoint leaf {i} has shape {tuple(arr.shape)} but the "
+                f"template expects {tmpl_shape} — the checkpoint at {path} "
+                "belongs to a different configuration"
+            )
+        leaf = jax.numpy.asarray(arr, dtype=getattr(tmpl, "dtype", None))
+        if isinstance(tmpl, jax.Array) and hasattr(tmpl, "sharding"):
+            leaf = jax.device_put(leaf, tmpl.sharding)
+        new_leaves.append(leaf)
     return jax.tree.unflatten(treedef, new_leaves), step

@@ -108,3 +108,29 @@ def test_row_exchange(mesh):
 def test_to_dense_blocks_identity(mesh):
     bm = mt.BlockMatrix.ones(4, 4, mesh=mesh)
     assert bm.to_dense_blocks() is bm
+
+
+def test_checkpoint_shape_mismatch_raises(tmp_path):
+    import jax.numpy as jnp
+
+    from marlin_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+
+    save_checkpoint({"w": jnp.zeros((4, 4))}, str(tmp_path), step=1)
+    with pytest.raises(ValueError):
+        load_checkpoint({"w": jnp.zeros((8, 8))}, str(tmp_path))
+
+
+def test_checkpoint_restores_sharding(tmp_path, mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from marlin_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+
+    sh = NamedSharding(mesh, P("rows", None))
+    w = jax.device_put(jnp.arange(16.0).reshape(8, 2), sh)
+    save_checkpoint({"w": w}, str(tmp_path), step=3)
+    restored, step = load_checkpoint({"w": w}, str(tmp_path))
+    assert step == 3
+    assert restored["w"].sharding == sh
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(w))

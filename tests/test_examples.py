@@ -145,3 +145,11 @@ def test_genmat_tool(tmp_path, mesh):
     assert again.stdout == out.stdout
     other = subprocess.run([exe, "5", "4", "8"], capture_output=True, text=True)
     assert other.stdout != out.stdout
+
+
+def test_distributed_training_cli(capsys, tmp_path):
+    from examples.distributed_training import main
+
+    main(["60", "16", "64", str(tmp_path / "ckpt")])
+    out = capsys.readouterr().out
+    assert "data-parallel" in out and "accuracy" in out

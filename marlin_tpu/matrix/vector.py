@@ -159,6 +159,11 @@ class DistributedVector:
     def sum(self):
         return jnp.sum(self.data)
 
+    def norm(self, ord: int | float = 2):
+        """Vector norm over the logical elements (negative ords would be
+        corrupted by the zero pads, so compute on the unpadded view)."""
+        return jnp.linalg.norm(self.logical(), ord=ord)
+
     def __repr__(self):
         kind = "col" if self.column_major else "row"
         return f"{type(self).__name__}(length={self._length}, {kind}, dtype={self.dtype})"

@@ -1,0 +1,12 @@
+"""Model families (canonical namespace).
+
+The reference's "models" are workloads built from its matrix primitives
+(SURVEY.md §0): a 2-layer MLP on MNIST, logistic regression, PageRank, and
+ALS matrix factorization. They are implemented in :mod:`marlin_tpu.ml`; this
+package re-exports them under the conventional ``models`` name.
+"""
+
+from ..ml.als import ALSModel, als_run  # noqa: F401
+from ..ml.logistic_regression import LogisticRegressionModel, logistic_regression  # noqa: F401
+from ..ml.neural_network import NeuralNetwork, mlp_forward, mlp_init, train_step  # noqa: F401
+from ..ml.pagerank import build_transition_matrix, pagerank  # noqa: F401

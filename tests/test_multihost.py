@@ -1,0 +1,83 @@
+"""Real multi-process distributed test: two OS processes, each with 4 CPU
+devices, joined via ``jax.distributed`` into one 8-device global mesh running
+a sharded matmul — the closest single-machine analog of the reference's
+multi-executor Spark cluster (its tests stop at threaded local[2];
+this goes further: separate processes, a real coordinator, cross-process
+collectives)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address="127.0.0.1:%PORT%",
+                           num_processes=2, process_id=proc_id)
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import marlin_tpu as mt
+
+assert len(jax.devices()) == 8, f"expected 8 global devices, got {len(jax.devices())}"
+mesh = mt.create_mesh((4, 2))
+
+# global sharded matmul across both processes
+a_np = np.arange(64, dtype=np.float32).reshape(8, 8) / 64.0
+b_np = np.eye(8, dtype=np.float32) * 2.0
+
+# build the global array from per-process shards
+sharding = NamedSharding(mesh, P("rows", None))
+a = jax.make_array_from_callback((8, 8), sharding, lambda idx: a_np[idx])
+b = jax.make_array_from_callback((8, 8), sharding, lambda idx: b_np[idx])
+
+from marlin_tpu.parallel import gspmd_matmul
+c = gspmd_matmul(a, b, NamedSharding(mesh, P("rows", "cols")))
+expected_total = float((a_np @ b_np).sum())
+total = float(jax.jit(jnp.sum)(c))  # cross-process psum under the hood
+assert abs(total - expected_total) < 1e-4, (total, expected_total)
+print(f"proc {proc_id}: global sum ok ({total:.4f})", flush=True)
+# skip jax.distributed.shutdown(): Gloo teardown hangs intermittently; a
+# clean process exit is sufficient and what the timeout guard needs
+os._exit(0)
+"""
+
+
+@pytest.mark.skipif(os.environ.get("MARLIN_SKIP_MULTIHOST") == "1",
+                    reason="multi-host test disabled")
+def test_two_process_mesh(tmp_path):
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.replace("%PORT%", str(port)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + \
+        os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(i)],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, env=env)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-host worker timed out")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+        assert "global sum ok" in out

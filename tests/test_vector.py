@@ -66,3 +66,19 @@ def test_matvec_through_matrix(mesh):
     out = m.multiply(v)
     assert isinstance(out, mt.DistributedVector)
     np.testing.assert_allclose(out.to_numpy(), a @ x, rtol=1e-4, atol=1e-4)
+
+
+def test_vector_norm(mesh):
+    x = np.array([3.0, -4.0, 0.0], np.float32)
+    v = mt.DistributedVector.from_array(x, mesh)
+    assert float(v.norm()) == pytest.approx(5.0)
+    assert float(v.norm(1)) == pytest.approx(7.0)
+    assert float(v.norm(np.inf)) == pytest.approx(4.0)
+
+
+def test_vector_norm_negative_ord(mesh):
+    # length 3 pads to 8 on this mesh — negative ords must ignore the pads
+    x = np.array([3.0, -4.0, 2.0], np.float32)
+    v = mt.DistributedVector.from_array(x, mesh)
+    assert float(v.norm(-np.inf)) == pytest.approx(2.0)
+    assert float(v.norm(-1)) == pytest.approx(np.linalg.norm(x, -1), rel=1e-5)

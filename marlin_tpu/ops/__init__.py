@@ -1,9 +1,13 @@
 from .local import (  # noqa: F401
+    axpy,
+    dspr,
     gemm,
     matvec,
-    dspr,
-    syrk,
-    mult_sparse_dense,
     mult_dense_sparse,
+    mult_sparse_dense,
     mult_sparse_sparse,
+    syrk,
+    triu_to_full,
 )
+from .sparse_bsr import BsrMatrix, bsr_from_dense, bsr_spmm  # noqa: F401
+from .sparse_ell import EllMatrix, ell_from_coo, ell_spmm  # noqa: F401

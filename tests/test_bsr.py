@@ -1,0 +1,75 @@
+"""BSR block-sparse matrices vs dense oracles."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from marlin_tpu.ops.sparse_bsr import BsrMatrix, bsr_from_dense, bsr_spmm
+
+
+def _block_sparse_dense(m, n, bs, keep_prob, seed):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((m, n)).astype(np.float32)
+    mask = rng.random((-(-m // bs), -(-n // bs))) < keep_prob
+    full = np.zeros((-(-m // bs) * bs, -(-n // bs) * bs), np.float32)
+    full[:m, :n] = a
+    grid = full.reshape(-(-m // bs), bs, -(-n // bs), bs).transpose(0, 2, 1, 3)
+    grid[~mask] = 0.0
+    return grid.transpose(0, 2, 1, 3).reshape(full.shape)[:m, :n]
+
+
+def test_bsr_roundtrip():
+    dense = _block_sparse_dense(100, 80, 16, 0.3, 0)
+    bsr = bsr_from_dense(dense, block_size=16)
+    assert 0 < bsr.nnzb < (112 // 16) * (80 // 16)
+    np.testing.assert_allclose(np.asarray(bsr.to_dense())[:100, :80], dense)
+
+
+def test_bsr_spmm_matches_dense():
+    dense = _block_sparse_dense(96, 64, 16, 0.4, 1)
+    bsr = bsr_from_dense(dense, block_size=16)
+    b = np.random.default_rng(2).standard_normal((64, 24)).astype(np.float32)
+    out = bsr_spmm(bsr, jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(out), dense @ b, rtol=1e-3, atol=1e-3)
+
+
+def test_bsr_spmm_chunked_boundary():
+    dense = _block_sparse_dense(64, 64, 8, 0.5, 3)
+    bsr = bsr_from_dense(dense, block_size=8)
+    b = np.random.default_rng(4).standard_normal((64, 8)).astype(np.float32)
+    # tiny chunk forces multiple scan steps + padding to the chunk multiple
+    out = bsr_spmm(bsr, jnp.asarray(b), chunk_blocks=3)
+    np.testing.assert_allclose(np.asarray(out), dense @ b, rtol=1e-3, atol=1e-3)
+
+
+def test_bsr_ragged_shapes():
+    # m, n not multiples of the block size
+    dense = _block_sparse_dense(50, 37, 16, 0.6, 5)
+    bsr = bsr_from_dense(dense, block_size=16)
+    b = np.random.default_rng(6).standard_normal((37, 5)).astype(np.float32)
+    out = bsr_spmm(bsr, jnp.asarray(b))
+    assert out.shape == (50, 5)
+    np.testing.assert_allclose(np.asarray(out), dense @ b, rtol=1e-3, atol=1e-3)
+
+
+def test_bsr_dim_mismatch():
+    bsr = bsr_from_dense(np.eye(32, dtype=np.float32), block_size=16)
+    with pytest.raises(ValueError):
+        bsr_spmm(bsr, jnp.ones((8, 4)))
+
+
+def test_bsr_tolerance_drop():
+    a = np.zeros((32, 32), np.float32)
+    a[:16, :16] = 1e-9  # below tol
+    a[16:, 16:] = 1.0
+    bsr = bsr_from_dense(a, block_size=16, tol=1e-6)
+    assert bsr.nnzb == 1
+
+
+def test_bsr_empty():
+    bsr = bsr_from_dense(np.zeros((256, 256), np.float32), block_size=128)
+    assert bsr.nnzb == 0
+    out = bsr_spmm(bsr, jnp.ones((256, 4)))
+    assert out.shape == (256, 4)
+    assert float(jnp.abs(out).max()) == 0.0

@@ -21,6 +21,16 @@ block only* (the reference LUs just the collected pivot block,
 DenseVecMatrix.scala:345-349), with row swaps applied across the full width and
 the global permutation accumulated.
 
+Numerical trade-off, stated: panel updates multiply by the explicitly inverted
+b×b pivot triangles (one small solve per step, then MXU GEMMs across the
+panel) instead of running n-wide triangular solves. For an ill-conditioned
+pivot block (κ ≈ 1/eps) the inverse carries κ·eps relative error into the
+panel, where backward-stable solves would not — the same trade the reference
+makes by broadcasting pivot inverses (DenseVecMatrix.scala:370-387), and
+consistent with block-local pivoting already bounding stability. Accuracy-
+critical callers with adversarial inputs should use mode="local" (LAPACK-style
+full factorization).
+
 Square inputs are padded with an identity tail so the padded problem stays
 nonsingular; block size comes from the config knobs that mirror
 ``marlin.lu.basesize``/``marlin.cholesky.basesize``/``marlin.inverse.basesize``.
@@ -64,6 +74,8 @@ def _blocked_lu(a: jax.Array, block: int, sharding=None):
     col_idx = jnp.arange(n)
     row_idx = jnp.arange(n)[:, None]
 
+    eye_b = jnp.eye(block)
+
     def body(i, carry):
         a, gperm = carry
         o = i * block
@@ -71,6 +83,12 @@ def _blocked_lu(a: jax.Array, block: int, sharding=None):
         lu, _, p = jax.lax.linalg.lu(piv)
         l11 = jnp.tril(lu, -1) + jnp.eye(block, dtype=a.dtype)
         u11 = jnp.triu(lu)
+        # invert the small triangles once (b×b solves), so the full-width
+        # panel updates become GEMMs on the MXU instead of n-wide triangular
+        # solves — the same trick the reference's panel updates use
+        # (broadcast pivot inverse, DenseVecMatrix.scala:370-387)
+        l11_inv = solve(l11, eye_b.astype(a.dtype), lower=True, unit_diagonal=True)
+        u11_inv = solve(u11.T, eye_b.astype(a.dtype), lower=True).T
 
         # Row panel (rows o:o+b, full width): permute rows, then
         #   cols <  o      -> permuted L-part unchanged
@@ -78,7 +96,7 @@ def _blocked_lu(a: jax.Array, block: int, sharding=None):
         #   cols >= o+b    -> U12 = L11^{-1} (P A12)
         rpan = jax.lax.dynamic_slice(a, (o, 0), (block, n))
         rpan = rpan[p, :]
-        u12 = solve(l11, rpan, lower=True, unit_diagonal=True)
+        u12 = jnp.dot(l11_inv, rpan, precision="highest")
         in_block = (col_idx[None, :] >= o) & (col_idx[None, :] < o + block)
         lu_wide = jax.lax.dynamic_update_slice(jnp.zeros_like(rpan), lu, (0, o))
         rpan_new = jnp.where(
@@ -89,7 +107,7 @@ def _blocked_lu(a: jax.Array, block: int, sharding=None):
         # Column panel (full height, cols o:o+b): rows >= o+b get
         # L21 = A21 U11^{-1}; rows above keep what's already written.
         cpan = jax.lax.dynamic_slice(a, (0, o), (n, block))
-        l21 = solve(u11.T, cpan.T, lower=True).T
+        l21 = jnp.dot(cpan, u11_inv, precision="highest")
         below = row_idx >= o + block
         cpan_new = jnp.where(below, l21, cpan)
         a = jax.lax.dynamic_update_slice(a, cpan_new, (0, o))
@@ -118,13 +136,16 @@ def _blocked_cholesky(a: jax.Array, block: int, sharding=None):
     solve = jax.scipy.linalg.solve_triangular
     row_idx = jnp.arange(n)[:, None]
 
+    eye_b = jnp.eye(block)
+
     def body(i, a):
         o = i * block
         piv = jax.lax.dynamic_slice(a, (o, o), (block, block))
         l11 = jnp.linalg.cholesky(piv)
+        l11_inv = solve(l11, eye_b.astype(a.dtype), lower=True)
 
         cpan = jax.lax.dynamic_slice(a, (0, o), (n, block))
-        l21 = solve(l11, cpan.T, lower=True).T
+        l21 = jnp.dot(cpan, l11_inv.T, precision="highest")
         below = row_idx >= o + block
         at_block = (row_idx >= o) & (row_idx < o + block)
         l11_tall = jax.lax.dynamic_update_slice(jnp.zeros_like(cpan), l11, (o, 0))

@@ -168,7 +168,9 @@ class SparseVecMatrix:
 
         ``format``: "bcoo" uses the BCOO dot_general; "ell" uses the chunked
         gather SpMM (marlin_tpu.ops.sparse_ell — the config-5 low-density
-        path); "auto" picks ELL below ~1% density."""
+        path); "bsr" routes through the block-sparse MXU kernel
+        (marlin_tpu.ops.sparse_bsr — right when the sparsity is structured in
+        dense blocks); "auto" picks ELL below ~1% density."""
         from .dense import BlockMatrix, DenseMatrix
 
         if isinstance(other, SparseVecMatrix):
@@ -181,9 +183,29 @@ class SparseVecMatrix:
             out = ell_spmm(self.to_ell(), dense)
         elif format == "bcoo":
             out = mult_sparse_dense(self.bcoo, dense)
+        elif format == "bsr":
+            out = self.to_bsr().multiply(dense)
         else:
             raise ValueError(f"unknown SpMM format: {format}")
         return BlockMatrix.from_array(out, self.mesh)
+
+    def to_bsr(self, block_size: int = 128):
+        """Convert to block-sparse storage (cached per block size); only
+        worthwhile when the nonzeros cluster into dense blocks. Converts
+        straight from the COO triplets — never densifies, so memory stays at
+        block-storage cost."""
+        from ..ops.sparse_bsr import bsr_from_coo
+
+        cache = getattr(self, "_bsr_cache", None)
+        if cache is None:
+            cache = self._bsr_cache = {}
+        if block_size not in cache:
+            b = self.bcoo.sum_duplicates()
+            cache[block_size] = bsr_from_coo(
+                np.asarray(b.indices[:, 0]), np.asarray(b.indices[:, 1]),
+                np.asarray(b.data), self._shape, block_size=block_size,
+            )
+        return cache[block_size]
 
     def to_ell(self, k_width: int | None = None):
         """Convert to ELL storage, cached per k_width. ``k_width=None`` caps

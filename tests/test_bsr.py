@@ -73,3 +73,38 @@ def test_bsr_empty():
     out = bsr_spmm(bsr, jnp.ones((256, 4)))
     assert out.shape == (256, 4)
     assert float(jnp.abs(out).max()) == 0.0
+
+
+def test_sparse_matrix_bsr_path(mesh):
+    import marlin_tpu as mt
+
+    dense = _block_sparse_dense(128, 96, 32, 0.4, 7)
+    sp = mt.SparseVecMatrix.from_dense(dense, mesh)
+    b = np.random.default_rng(8).standard_normal((96, 10)).astype(np.float32)
+    out = sp.multiply(mt.BlockMatrix.from_array(b, mesh), format="bsr")
+    np.testing.assert_allclose(out.to_numpy(), dense @ b, rtol=1e-3, atol=1e-3)
+    bsr = sp.to_bsr(block_size=32)
+    assert bsr.block_size == 32 and bsr.nnzb > 0
+
+
+def test_bsr_from_coo_no_densify():
+    from marlin_tpu.ops.sparse_bsr import bsr_from_coo
+
+    dense = _block_sparse_dense(96, 64, 16, 0.3, 9)
+    rows, cols = np.nonzero(dense)
+    bsr = bsr_from_coo(rows, cols, dense[rows, cols], (96, 64), block_size=16)
+    np.testing.assert_allclose(np.asarray(bsr.to_dense()), dense)
+    b = np.random.default_rng(10).standard_normal((64, 6)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(bsr_spmm(bsr, jnp.asarray(b))),
+                               dense @ b, rtol=1e-3, atol=1e-3)
+
+
+def test_bsr_from_coo_duplicates_sum():
+    from marlin_tpu.ops.sparse_bsr import bsr_from_coo
+
+    rows = np.array([0, 0, 5])
+    cols = np.array([1, 1, 7])
+    vals = np.array([2.0, 3.0, 1.0], np.float32)
+    bsr = bsr_from_coo(rows, cols, vals, (8, 8), block_size=4)
+    dense = np.asarray(bsr.to_dense())
+    assert dense[0, 1] == 5.0 and dense[5, 7] == 1.0

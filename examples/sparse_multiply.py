@@ -6,7 +6,8 @@ mode 2: sparse × sparse via densify
 mode 3: block sparse × block sparse (BCOO contraction)
 mode 4: dense × dense (baseline)
 mode 5: dense × sparse
-mode 6: sparse × dense
+mode 6: sparse × dense (ELL/BCOO auto)
+mode 7: sparse × dense through the BSR block-sparse MXU kernel
 """
 
 import sys
@@ -18,7 +19,7 @@ from examples._common import die, millis
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) < 5:
-        die("usage: sparse_multiply <A rows> <A cols> <B cols> <density> <mode 1-6>")
+        die("usage: sparse_multiply <A rows> <A cols> <B cols> <density> <mode 1-7>")
     rows, k, cols = (int(x) for x in argv[:3])
     density, mode = float(argv[3]), int(argv[4])
 
@@ -61,8 +62,17 @@ def main(argv=None):
         c = sa.multiply(db)
         mt.evaluate(c)
         print(f"sparse×dense {millis() - t0:.1f} millis")
+    elif mode == 7:
+        db = mt.BlockMatrix.random(1, k, cols, mesh=mesh)
+        mt.evaluate(db)
+        t0 = millis()
+        c = sa.multiply(db, format="bsr")
+        mt.evaluate(c)
+        bsr = sa.to_bsr()
+        print(f"sparse×dense via BSR {millis() - t0:.1f} millis "
+              f"(nnzb {bsr.nnzb}, block density {bsr.density:.3f})")
     else:
-        die("mode must be 1-6")
+        die("mode must be 1-7")
 
 
 if __name__ == "__main__":

@@ -27,7 +27,7 @@ construction instead of carrying ragged blocks everywhere.
 from __future__ import annotations
 
 import math
-from typing import Any, Sequence
+from typing import Any
 
 import jax
 import jax.numpy as jnp

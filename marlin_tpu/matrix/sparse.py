@@ -154,6 +154,18 @@ class SparseVecMatrix:
     def nnz(self) -> int:
         return int(self.bcoo.nse)
 
+    def _coo_triplets(self):
+        """Deduplicated (rows, cols, vals) numpy triplets, computed once —
+        shared by the ELL/BSR/COO conversion paths."""
+        if getattr(self, "_triplets", None) is None:
+            b = self.bcoo.sum_duplicates()
+            self._triplets = (
+                np.asarray(b.indices[:, 0]),
+                np.asarray(b.indices[:, 1]),
+                np.asarray(b.data),
+            )
+        return self._triplets
+
     def multiply_sparse(self, other: "SparseVecMatrix") -> CoordinateMatrix:
         """Sparse × sparse with sparse (COO) result — the role of the
         outer-product shuffle multiply (SparseVecMatrix.multiplySparse,
@@ -200,11 +212,9 @@ class SparseVecMatrix:
         if cache is None:
             cache = self._bsr_cache = {}
         if block_size not in cache:
-            b = self.bcoo.sum_duplicates()
-            cache[block_size] = bsr_from_coo(
-                np.asarray(b.indices[:, 0]), np.asarray(b.indices[:, 1]),
-                np.asarray(b.data), self._shape, block_size=block_size,
-            )
+            rows, cols, vals = self._coo_triplets()
+            cache[block_size] = bsr_from_coo(rows, cols, vals, self._shape,
+                                             block_size=block_size)
         return cache[block_size]
 
     def to_ell(self, k_width: int | None = None):
@@ -220,11 +230,9 @@ class SparseVecMatrix:
         if cache is None:
             cache = self._ell_cache = {}
         if k_width not in cache:
-            b = self.bcoo.sum_duplicates()
-            cache[k_width] = ell_from_coo(
-                np.asarray(b.indices[:, 0]), np.asarray(b.indices[:, 1]),
-                np.asarray(b.data), self._shape, k_width=k_width,
-            )
+            rows, cols, vals = self._coo_triplets()
+            cache[k_width] = ell_from_coo(rows, cols, vals, self._shape,
+                                          k_width=k_width)
         return cache[k_width]
 
     def to_dense_vec_matrix(self, mesh: Mesh | None = None):
@@ -234,9 +242,8 @@ class SparseVecMatrix:
         return DenseVecMatrix.from_array(self.bcoo.todense(), mesh or self.mesh)
 
     def to_coordinate_matrix(self) -> CoordinateMatrix:
-        b = self.bcoo.sum_duplicates()
-        return CoordinateMatrix(b.indices[:, 0], b.indices[:, 1], b.data,
-                                shape=self._shape, mesh=self.mesh)
+        rows, cols, vals = self._coo_triplets()
+        return CoordinateMatrix(rows, cols, vals, shape=self._shape, mesh=self.mesh)
 
     def to_numpy(self) -> np.ndarray:
         return np.asarray(jax.device_get(self.bcoo.todense()))

@@ -11,14 +11,12 @@ variant (matrix/DistributedIntVector.scala). TPU-first this is a 1-D sharded
 
 from __future__ import annotations
 
-from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..config import get_config
 from ..mesh import ROWS, default_mesh, pad_to_multiple
 from ..random import ensure_key, random_array
 

@@ -61,7 +61,7 @@ def test_rmm_compare_cli(capsys):
 def test_sparse_multiply_cli(capsys):
     from examples.sparse_multiply import main
 
-    for mode in "123456":
+    for mode in "1234567":
         main(["32", "32", "32", "0.1", mode])
     out = capsys.readouterr().out
     assert "millis" in out

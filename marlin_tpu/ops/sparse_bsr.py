@@ -92,12 +92,17 @@ def bsr_from_coo(rows, cols, vals, shape, block_size: int = 128) -> BsrMatrix:
     nbc = -(-n // bs)
     block_id = (rows // bs) * nbc + (cols // bs)
     uniq, inv = np.unique(block_id, return_inverse=True)
-    # one vectorized bincount pass (np.add.at's per-element loop is far
-    # slower at large nnz)
+    # sort + reduceat: vectorized accumulation in the values' own dtype with
+    # O(nnz) extra memory (np.add.at is per-element slow; np.bincount would
+    # force a float64 intermediate the size of all blocks)
     flat = inv * (bs * bs) + (rows % bs) * bs + (cols % bs)
-    blocks = np.bincount(
-        flat, weights=vals.astype(np.float64), minlength=len(uniq) * bs * bs
-    ).astype(vals.dtype).reshape(len(uniq), bs, bs)
+    order = np.argsort(flat, kind="stable")
+    fs, vs = flat[order], vals[order]
+    starts = np.flatnonzero(np.r_[True, fs[1:] != fs[:-1]])
+    sums = np.add.reduceat(vs, starts)
+    blocks = np.zeros(len(uniq) * bs * bs, vals.dtype)
+    blocks[fs[starts]] = sums
+    blocks = blocks.reshape(len(uniq), bs, bs)
     return BsrMatrix(
         jnp.asarray(blocks),
         jnp.asarray(uniq // nbc, jnp.int32),

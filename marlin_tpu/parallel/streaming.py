@@ -46,6 +46,7 @@ def streamed_matmul(
     chunk_rows: int = 1 << 18,
     out: np.ndarray | None = None,
     precision: str | None = None,
+    transfer_dtype=None,
 ) -> np.ndarray | None:
     """``A @ B`` where A streams through the device in row chunks.
 
@@ -54,13 +55,18 @@ def streamed_matmul(
     ``out``: optional preallocated (m, n) host array (e.g. a writable memmap)
     filled in place; otherwise chunks are collected and stacked (only sensible
     when the result fits host RAM).
+    ``transfer_dtype="bfloat16"`` halves H2D bytes (host-side cast).
     """
     precision = precision or get_config().matmul_precision
     b_dev = jnp.asarray(b.logical() if hasattr(b, "logical") else b)
 
     @jax.jit
     def chunk_mm(x):
-        return jnp.dot(x, b_dev, precision=precision)
+        # re-expand compressed uploads without ever *down*-casting: promote to
+        # the wider of the two dtypes (f32 a × bf16 b stays f32; bf16 uploads
+        # widen to b's dtype)
+        return jnp.dot(x.astype(jnp.promote_types(x.dtype, b_dev.dtype)), b_dev,
+                       precision=precision)
 
     results, offset, pending, saw_chunk = [], 0, [], False
 
@@ -77,12 +83,29 @@ def streamed_matmul(
 
     for chunk in _as_chunks(a_source, chunk_rows):
         saw_chunk = True
-        pending.append(chunk_mm(jnp.asarray(chunk)))
+        pending.append(chunk_mm(jnp.asarray(_compress_for_transfer(chunk, transfer_dtype))))
         drain(1)  # keep one chunk in flight: overlap H2D/compute/D2H
     if not saw_chunk:
         raise ValueError("empty input stream")
     drain(0)
     return out if out is not None else np.concatenate(results, axis=0)
+
+
+def _compress_for_transfer(chunk: np.ndarray, transfer_dtype) -> np.ndarray:
+    """Cast on the *host* before upload — the point is halving the H2D bytes
+    (the bottleneck of every streamed op), so the cast must not happen
+    device-side."""
+    if transfer_dtype is None:
+        return chunk
+    import ml_dtypes  # ships with jax
+
+    np_dtype = np.dtype(
+        {"bfloat16": ml_dtypes.bfloat16, "float16": np.float16}.get(
+            str(transfer_dtype), transfer_dtype
+        )
+    )
+    chunk = np.asarray(chunk)
+    return chunk if chunk.dtype == np_dtype else chunk.astype(np_dtype)
 
 
 def streamed_gramian(
@@ -91,18 +114,27 @@ def streamed_gramian(
     chunk_rows: int = 1 << 18,
     precision: str | None = None,
     dtype=jnp.float32,
+    transfer_dtype=None,
 ) -> np.ndarray:
     """``AᵀA`` with A streamed in row chunks and the n×n accumulator resident
-    on device — one rank-chunk ``syrk`` per chunk, no driver reduction."""
+    on device — one rank-chunk ``syrk`` per chunk, no driver reduction.
+
+    ``transfer_dtype="bfloat16"`` casts chunks on the host before upload,
+    halving H2D traffic (the streamed paths' bottleneck) at bf16 input
+    precision; accumulation stays in ``dtype`` (f32)."""
     precision = precision or get_config().matmul_precision
 
     @jax.jit
     def accumulate(g, x):
+        x = x.astype(dtype)
         return g + jnp.dot(x.T, x, precision=precision)
 
     g = None
+    # with no explicit transfer dtype, upload in the accumulation dtype (the
+    # pre-existing contract: `dtype` governs both upload width and accumulator)
+    effective_transfer = transfer_dtype if transfer_dtype is not None else dtype
     for chunk in _as_chunks(a_source, chunk_rows):
-        x = jnp.asarray(chunk, dtype=dtype)
+        x = jnp.asarray(_compress_for_transfer(chunk, effective_transfer))
         if n_cols is not None and x.shape[1] != n_cols:
             raise ValueError(f"chunk has {x.shape[1]} cols, expected {n_cols}")
         if g is None:

@@ -143,3 +143,17 @@ def test_dense_parity_fills(mesh):
         assert df.shape == (10, 8)
     except ImportError:
         pass
+
+
+def test_streamed_bf16_transfer():
+    rng = np.random.default_rng(12)
+    a = rng.standard_normal((500, 32)).astype(np.float32)
+    b = rng.standard_normal((32, 8)).astype(np.float32)
+    out = streamed_matmul(a, b, chunk_rows=128, transfer_dtype="bfloat16")
+    # bf16-rounded inputs: expect ~1% relative error on O(1) dot products
+    np.testing.assert_allclose(out, a @ b, rtol=5e-2, atol=8e-2)
+    g = streamed_gramian(a, chunk_rows=128, transfer_dtype="bfloat16")
+    np.testing.assert_allclose(g, a.T @ a, rtol=5e-2, atol=5e-1)
+    # exact when not compressed
+    g32 = streamed_gramian(a, chunk_rows=128)
+    np.testing.assert_allclose(g32, a.T @ a, rtol=1e-3, atol=1e-3)

@@ -494,6 +494,21 @@ class DenseMatrix(DistributedMatrix):
     def print_all(self):
         print(self.to_numpy())
 
+    def __getitem__(self, key):
+        """NumPy-style 2-D slicing returning a distributed submatrix (no
+        reference analog — sliceByRow/sliceByColumn cover inclusive ranges;
+        this is the pythonic face of the same thing). Integer indices are
+        bounds-checked — jax's gather would silently clamp them otherwise."""
+        if not isinstance(key, tuple) or len(key) != 2:
+            raise TypeError("expected 2-D index like m[rows, cols]")
+        for idx, limit in zip(key, self._shape):
+            if isinstance(idx, (int, np.integer)) and not -limit <= idx < limit:
+                raise IndexError(f"index {idx} out of bounds for size {limit}")
+        out = self.logical()[key]
+        if out.ndim != 2:
+            return out  # scalar or 1-D row/column: plain array
+        return self._wrap(out)
+
     def __repr__(self):
         return (
             f"{type(self).__name__}(shape={self._shape}, dtype={self.dtype}, "

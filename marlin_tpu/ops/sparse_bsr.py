@@ -89,6 +89,11 @@ def bsr_from_coo(rows, cols, vals, shape, block_size: int = 128) -> BsrMatrix:
     vals = np.asarray(vals)
     m, n = shape
     bs = block_size
+    if vals.size == 0:
+        return BsrMatrix(
+            jnp.zeros((0, bs, bs), vals.dtype if vals.dtype != np.int64 else np.float32),
+            jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.int32), (m, n), bs,
+        )
     nbc = -(-n // bs)
     block_id = (rows // bs) * nbc + (cols // bs)
     uniq, inv = np.unique(block_id, return_inverse=True)

@@ -108,3 +108,12 @@ def test_bsr_from_coo_duplicates_sum():
     bsr = bsr_from_coo(rows, cols, vals, (8, 8), block_size=4)
     dense = np.asarray(bsr.to_dense())
     assert dense[0, 1] == 5.0 and dense[5, 7] == 1.0
+
+
+def test_bsr_from_coo_empty():
+    from marlin_tpu.ops.sparse_bsr import bsr_from_coo
+
+    bsr = bsr_from_coo([], [], np.array([], np.float32), (64, 64), block_size=16)
+    assert bsr.nnzb == 0
+    out = bsr_spmm(bsr, jnp.ones((64, 3)))
+    assert float(jnp.abs(out).max()) == 0.0

@@ -205,3 +205,31 @@ def test_lr_converges(mesh):
     pred = 1.0 / (1.0 + np.exp(-(np.concatenate([np.ones((n, 1)), x], 1) @ w)))
     acc = ((pred > 0.5) == (y > 0.5)).mean()
     assert acc > 0.9
+
+
+def test_cross_mesh_operands(mesh, a4, b4):
+    # the reference errors on incompatible block grids (:420-432); here a
+    # different mesh is just a different layout — ops realign automatically
+    other_mesh = mt.create_mesh((4, 2))
+    ma = mt.BlockMatrix.from_array(a4, mesh)          # 2x4 grid
+    mb = mt.BlockMatrix.from_array(b4, other_mesh)    # 4x2 grid
+    assert_close(ma.add(mb), a4 + b4)
+    assert_close(ma.multiply(mb), a4 @ b4)
+
+
+def test_getitem_sugar(mesh, a4):
+    m = mt.BlockMatrix.from_array(a4, mesh)
+    assert_close(m[1:3, :2], a4[1:3, :2])
+    np.testing.assert_allclose(np.asarray(m[0, :]), a4[0, :])
+    assert float(m[2, 3]) == a4[2, 3]
+    with pytest.raises(TypeError):
+        m[1]
+
+
+def test_getitem_bounds_checked(mesh, a4):
+    m = mt.BlockMatrix.from_array(a4, mesh)
+    with pytest.raises(IndexError):
+        m[100, 0]
+    with pytest.raises(IndexError):
+        m[0, -5]
+    assert float(m[-1, -1]) == a4[-1, -1]  # negative indexing still works

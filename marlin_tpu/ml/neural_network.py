@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -38,35 +39,52 @@ __all__ = ["NeuralNetwork", "mlp_init", "mlp_forward", "mlp_loss", "train_step"]
 
 
 def mlp_init(key, layer_sizes: tuple[int, ...], dtype=jnp.float32) -> dict:
-    """Weight init, uniform in [-0.05, 0.05) like the reference's initial
-    weights scale (examples/NeuralNetwork.scala:205-206)."""
+    """Glorot-uniform weight init. The reference uses a fixed ±0.05 uniform
+    (examples/NeuralNetwork.scala:205-206) — nearly the same scale for its
+    2-layer 784→100→10 shape, but fan-scaled init keeps gradients alive when
+    ``layer_sizes`` goes deeper than the reference ever does."""
     params = {}
     keys = jax.random.split(key, len(layer_sizes) - 1)
     for i, (fan_in, fan_out) in enumerate(zip(layer_sizes[:-1], layer_sizes[1:])):
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
         params[f"w{i}"] = jax.random.uniform(
-            keys[i], (fan_in, fan_out), dtype, minval=-0.05, maxval=0.05
+            keys[i], (fan_in, fan_out), dtype, minval=-limit, maxval=limit
         )
     return params
 
 
-def mlp_forward(params: dict, x: jax.Array) -> jax.Array:
-    """σ(…σ(x·W0)·W1…) — the per-block forward (:221-231), whole-batch."""
+def mlp_forward(params: dict, x: jax.Array, activation: str = "sigmoid") -> jax.Array:
+    """σ(…σ(x·W0)·W1…) — the per-block forward (:221-231), whole-batch.
+    ``activation`` applies to hidden layers ("sigmoid" is the reference's
+    choice and the default; "relu" keeps gradients alive in deep stacks);
+    the output layer is always sigmoid, matching the reference's output-error
+    convention."""
+    activations = {"sigmoid": jax.nn.sigmoid, "relu": jax.nn.relu,
+                   "tanh": jnp.tanh}
+    if activation not in activations:
+        raise ValueError(
+            f"unknown activation {activation!r}; choose from {sorted(activations)}"
+        )
+    act = activations[activation]
     h = x
     n_layers = len(params)
     for i in range(n_layers):
-        h = jax.nn.sigmoid(h @ params[f"w{i}"])
+        z = h @ params[f"w{i}"]
+        h = jax.nn.sigmoid(z) if i == n_layers - 1 else act(z)
     return h
 
 
-def mlp_loss(params: dict, x: jax.Array, y: jax.Array) -> jax.Array:
+def mlp_loss(params: dict, x: jax.Array, y: jax.Array,
+             activation: str = "sigmoid") -> jax.Array:
     """Squared-error loss matching the reference's output-error convention
     (computeOutputError, examples/NeuralNetwork.scala:119-128)."""
-    out = mlp_forward(params, x)
+    out = mlp_forward(params, x, activation)
     return 0.5 * jnp.mean(jnp.sum((out - y) ** 2, axis=-1))
 
 
-@functools.partial(jax.jit, static_argnames=("batch_size", "lr", "remat"))
-def train_step(params, x, y, key, batch_size: int, lr: float, remat: bool = False):
+@functools.partial(jax.jit, static_argnames=("batch_size", "lr", "remat", "activation"))
+def train_step(params, x, y, key, batch_size: int, lr: float, remat: bool = False,
+               activation: str = "sigmoid"):
     """One SPMD step: strided batch sample + grad + SGD update. ``remat=True``
     rematerializes the forward in the backward pass (``jax.checkpoint``) —
     trading FLOPs for activation memory, the knob for models/batches near the
@@ -76,7 +94,11 @@ def train_step(params, x, y, key, batch_size: int, lr: float, remat: bool = Fals
     offset = jax.random.randint(key, (), 0, m)
     idx = (offset + jnp.arange(batch_size) * stride) % m
     xb, yb = x[idx], y[idx]
-    loss_fn = jax.checkpoint(mlp_loss) if remat else mlp_loss
+
+    def loss_with_act(p, xx, yy):
+        return mlp_loss(p, xx, yy, activation)
+
+    loss_fn = jax.checkpoint(loss_with_act) if remat else loss_with_act
     loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
     new_params = jax.tree.map(lambda w, g: w - lr * g, params, grads)
     return new_params, loss
@@ -86,22 +108,27 @@ def train_step(params, x, y, key, batch_size: int, lr: float, remat: bool = Fals
 class NeuralNetwork:
     """User-facing trainer mirroring the reference CLI's knobs
     (examples/NeuralNetwork.scala:186-208: layer sizes, iterations, step size,
-    batch fraction)."""
+    batch fraction). The reference is fixed at two layers; ``hidden_dim`` may
+    be an int (that case) or a tuple for arbitrary depth."""
 
     input_dim: int = 784
-    hidden_dim: int = 100
+    hidden_dim: int | tuple[int, ...] = 100
     output_dim: int = 10
     learning_rate: float = 0.5
     seed: int = 0
     remat: bool = False  # jax.checkpoint the forward (memory for FLOPs)
+    activation: str = "sigmoid"  # hidden activation; "relu" for deep stacks
+
+    @property
+    def layer_sizes(self) -> tuple[int, ...]:
+        hidden = (
+            (self.hidden_dim,) if isinstance(self.hidden_dim, int) else tuple(self.hidden_dim)
+        )
+        return (self.input_dim, *hidden, self.output_dim)
 
     def init_params(self, mesh=None, dtype=jnp.float32) -> dict:
         mesh = mesh or default_mesh()
-        params = mlp_init(
-            jax.random.key(self.seed),
-            (self.input_dim, self.hidden_dim, self.output_dim),
-            dtype,
-        )
+        params = mlp_init(jax.random.key(self.seed), self.layer_sizes, dtype)
         repl = NamedSharding(mesh, P())
         return jax.tree.map(lambda w: jax.device_put(w, repl), params)
 
@@ -141,7 +168,8 @@ class NeuralNetwork:
         for it in range(iterations):
             key, sub = jax.random.split(key)
             params, loss = train_step(
-                params, x, y, sub, batch_size, self.learning_rate, self.remat
+                params, x, y, sub, batch_size, self.learning_rate, self.remat,
+                self.activation,
             )
             if log_every and (it + 1) % log_every == 0:
                 print(f"iter {it + 1}: loss {float(loss):.6f}")
@@ -152,7 +180,8 @@ class NeuralNetwork:
 
     def predict(self, params: dict, data) -> np.ndarray:
         x = data.logical() if hasattr(data, "logical") else jnp.asarray(data)
-        return np.asarray(jax.device_get(jnp.argmax(mlp_forward(params, x), axis=-1)))
+        return np.asarray(jax.device_get(
+            jnp.argmax(mlp_forward(params, x, self.activation), axis=-1)))
 
     def accuracy(self, params: dict, data, labels) -> float:
         pred = self.predict(params, data)

@@ -87,3 +87,31 @@ def test_pagerank_dense_vs_sparse(mesh):
     # stationarity: r ≈ damping*M@r + (1-d)/n
     resid = 0.85 * m @ r_dense + 0.15 / 5 - r_dense
     assert np.abs(resid).max() < 1e-4
+
+
+def test_nn_deep(mesh, separable):
+    x, y = separable
+    data = mt.DenseVecMatrix.from_array(x, mesh)
+    nn = NeuralNetwork(input_dim=10, hidden_dim=(16, 12, 8), output_dim=2,
+                       learning_rate=2.0, seed=0)
+    assert nn.layer_sizes == (10, 16, 12, 8, 2)
+    # deep sigmoid stacks train slowly (vanishing gradients) — the test is
+    # about mechanics: 4 weight matrices, loss decreasing, better than chance
+    params, losses = nn.train(data, y, iterations=400, batch_size=128)
+    assert len(params) == 4  # w0..w3
+    assert losses[-1] < losses[0]
+    assert nn.accuracy(params, data, y) > 0.7
+
+
+def test_nn_activation_validation(mesh, separable):
+    x, y = separable
+    data = mt.DenseVecMatrix.from_array(x, mesh)
+    nn = NeuralNetwork(input_dim=10, hidden_dim=8, output_dim=2, activation="sigmod")
+    with pytest.raises(ValueError):
+        nn.train(data, y, iterations=1, batch_size=32)
+    # relu + tanh both accepted
+    for act in ("relu", "tanh"):
+        nn = NeuralNetwork(input_dim=10, hidden_dim=8, output_dim=2,
+                           learning_rate=0.2, activation=act, seed=1)
+        params, losses = nn.train(data, y, iterations=20, batch_size=64)
+        assert np.isfinite(losses).all()

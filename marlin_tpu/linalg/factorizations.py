@@ -16,10 +16,14 @@ XLA; masks replace the shrinking trailing extents), and the trailing update is
 a full-size rank-b GEMM with masked operands — zero contribution outside the
 trailing region, so no dynamic shapes anywhere.
 
-Pivoting matches the reference's choice: partial pivoting *within the pivot
-block only* (the reference LUs just the collected pivot block,
-DenseVecMatrix.scala:345-349), with row swaps applied across the full width and
-the global permutation accumulated.
+Pivoting: the default (``pivot="block"``) matches the reference's choice —
+partial pivoting *within the pivot block only* (the reference LUs just the
+collected pivot block, DenseVecMatrix.scala:345-349) — with row swaps applied
+across the full width and the global permutation accumulated.
+``pivot="panel"`` upgrades to LAPACK getrf-style full-height panel pivoting
+(pivot search over the entire trailing column), which handles singular or
+ill-conditioned pivot blocks the block-local strategy cannot, at the cost of a
+serial per-column panel phase.
 
 Numerical trade-off, stated: panel updates multiply by the explicitly inverted
 b×b pivot triangles (one small solve per step, then MXU GEMMs across the
@@ -63,6 +67,24 @@ def _pad_with_identity(a: jax.Array, n_pad: int) -> jax.Array:
     return out.at[pad_diag, pad_diag].set(jnp.ones((), a.dtype))
 
 
+def _trailing_update(a: jax.Array, o, block: int, u12: jax.Array):
+    """Shared epilogue of both LU variants: write U12 right of the panel and
+    subtract the masked rank-b outer product — zero outside the trailing
+    region, so the full-size GEMM only touches A22. Expects the column panel
+    of ``a`` to already hold L21 below the diagonal block."""
+    n = a.shape[0]
+    col_idx = jnp.arange(n)
+    row_idx = jnp.arange(n)[:, None]
+    right = col_idx[None, :] >= o + block
+    rpan = jax.lax.dynamic_slice(a, (o, 0), (block, n))
+    a = jax.lax.dynamic_update_slice(a, jnp.where(right, u12, rpan), (o, 0))
+    cpan = jax.lax.dynamic_slice(a, (0, o), (n, block))
+    below = row_idx >= o + block
+    l21_m = jnp.where(below, cpan, jnp.zeros((), a.dtype))
+    u12_m = jnp.where(right, u12, jnp.zeros((), a.dtype))
+    return a - jnp.dot(l21_m, u12_m, precision="highest")
+
+
 @functools.partial(jax.jit, static_argnames=("block", "sharding"))
 def _blocked_lu(a: jax.Array, block: int, sharding=None):
     """Right-looking blocked LU with block-local partial pivoting.
@@ -90,33 +112,26 @@ def _blocked_lu(a: jax.Array, block: int, sharding=None):
         l11_inv = solve(l11, eye_b.astype(a.dtype), lower=True, unit_diagonal=True)
         u11_inv = solve(u11.T, eye_b.astype(a.dtype), lower=True).T
 
-        # Row panel (rows o:o+b, full width): permute rows, then
-        #   cols <  o      -> permuted L-part unchanged
-        #   o..o+b         -> the combined lu block
-        #   cols >= o+b    -> U12 = L11^{-1} (P A12)
+        # Row panel (rows o:o+b): permute rows, keep the permuted L-part left
+        # of the panel, write the combined lu block into the diagonal; the
+        # right part (U12) is handled by the shared epilogue.
         rpan = jax.lax.dynamic_slice(a, (o, 0), (block, n))
         rpan = rpan[p, :]
-        u12 = jnp.dot(l11_inv, rpan, precision="highest")
         in_block = (col_idx[None, :] >= o) & (col_idx[None, :] < o + block)
         lu_wide = jax.lax.dynamic_update_slice(jnp.zeros_like(rpan), lu, (0, o))
-        rpan_new = jnp.where(
-            col_idx[None, :] < o, rpan, jnp.where(in_block, lu_wide, u12)
+        a = jax.lax.dynamic_update_slice(
+            a, jnp.where(in_block, lu_wide, rpan), (o, 0)
         )
-        a = jax.lax.dynamic_update_slice(a, rpan_new, (o, 0))
 
         # Column panel (full height, cols o:o+b): rows >= o+b get
         # L21 = A21 U11^{-1}; rows above keep what's already written.
         cpan = jax.lax.dynamic_slice(a, (0, o), (n, block))
         l21 = jnp.dot(cpan, u11_inv, precision="highest")
         below = row_idx >= o + block
-        cpan_new = jnp.where(below, l21, cpan)
-        a = jax.lax.dynamic_update_slice(a, cpan_new, (0, o))
+        a = jax.lax.dynamic_update_slice(a, jnp.where(below, l21, cpan), (0, o))
 
-        # Trailing update with masked operands: zero outside the trailing
-        # region, so the full-size GEMM only touches A22.
-        l21_m = jnp.where(below, l21, jnp.zeros((), a.dtype))
-        u12_m = jnp.where(col_idx[None, :] >= o + block, u12, jnp.zeros((), a.dtype))
-        a = a - jnp.dot(l21_m, u12_m, precision="highest")
+        u12 = jnp.dot(l11_inv, rpan, precision="highest")
+        a = _trailing_update(a, o, block, u12)
 
         # Accumulate the global permutation.
         gseg = jax.lax.dynamic_slice(gperm, (o,), (block,))
@@ -126,6 +141,89 @@ def _blocked_lu(a: jax.Array, block: int, sharding=None):
         return a, gperm
 
     return jax.lax.fori_loop(0, nb, body, (a, perm0))
+
+
+@functools.partial(jax.jit, static_argnames=("block", "sharding"))
+def _blocked_lu_panel_pivot(a: jax.Array, block: int, sharding=None):
+    """Right-looking blocked LU with *full-height panel pivoting* (LAPACK
+    getrf-style): each elimination column selects its pivot over the entire
+    trailing column, not just the b×b pivot block — the stability the
+    reference gives up by factorizing only the collected pivot block.
+
+    The sequential elimination runs on the (n × b) panel buffer only
+    (O(n·b) work per column); the chosen swaps are then replayed across the
+    full width in one O(n·b) pass (LAPACK's laswp), and the trailing update
+    is the shared masked rank-b GEMM. Returns (LU-combined, permutation)."""
+    n = a.shape[0]
+    nb = n // block
+    perm0 = jnp.arange(n, dtype=jnp.int32)
+    row_idx = jnp.arange(n)
+    eye_b = jnp.eye(block)
+    solve = jax.scipy.linalg.solve_triangular
+    panel_col_idx = jnp.arange(block)
+
+    def swap_rows(x, r1, r2):
+        row1 = x[r1]
+        row2 = x[r2]
+        x = x.at[r1].set(row2)
+        return x.at[r2].set(row1)
+
+    def body(i, carry):
+        a, gperm = carry
+        o = i * block
+        cpan0 = jax.lax.dynamic_slice(a, (0, o), (n, block))
+
+        # --- panel factorization with full-height pivoting, column by column,
+        # entirely within the (n, b) panel buffer
+        def col_step(j, carry_p):
+            pan, pivots = carry_p
+            c = o + j
+            col = jax.lax.dynamic_slice(pan, (0, j), (n, 1))[:, 0]
+            mag = jnp.where(row_idx >= c, jnp.abs(col), -1.0)
+            piv = jnp.argmax(mag)
+            pan = swap_rows(pan, c, piv)
+            pivots = pivots.at[j].set(piv)
+            col = jax.lax.dynamic_slice(pan, (0, j), (n, 1))[:, 0]
+            pivot_val = col[c]
+            safe = jnp.where(jnp.abs(pivot_val) > 0, pivot_val, 1.0)
+            factor = jnp.where(row_idx > c, col / safe, 0.0)
+            pivot_row = jax.lax.dynamic_slice(pan, (c, 0), (1, block))[0]
+            update = factor[:, None] * jnp.where(panel_col_idx > j, pivot_row,
+                                                 0.0)[None, :]
+            pan = pan - update
+            newcol = jnp.where(row_idx > c, factor, col)
+            pan = jax.lax.dynamic_update_slice(pan, newcol[:, None], (0, j))
+            return pan, pivots
+
+        pan, pivots = jax.lax.fori_loop(
+            0, block, col_step, (cpan0, jnp.zeros((block,), jnp.int32))
+        )
+
+        # --- replay the swaps across the full matrix + permutation (laswp);
+        # columns outside the panel are untouched by the elimination, so
+        # applying the same swap sequence afterwards is equivalent
+        def apply_swap(j, carry_s):
+            a, gperm = carry_s
+            c = o + j
+            piv = pivots[j]
+            return swap_rows(a, c, piv), swap_rows(gperm, c, piv)
+
+        a, gperm = jax.lax.fori_loop(0, block, apply_swap, (a, gperm))
+        a = jax.lax.dynamic_update_slice(a, pan, (0, o))
+
+        # --- shared epilogue: U12 from the panel's unit-lower triangle
+        lu_blk = jax.lax.dynamic_slice(a, (o, o), (block, block))
+        l11 = jnp.tril(lu_blk, -1) + jnp.eye(block, dtype=a.dtype)
+        l11_inv = solve(l11, eye_b.astype(a.dtype), lower=True, unit_diagonal=True)
+        rpan = jax.lax.dynamic_slice(a, (o, 0), (block, n))
+        u12 = jnp.dot(l11_inv, rpan, precision="highest")
+        a = _trailing_update(a, o, block, u12)
+        if sharding is not None:
+            a = jax.lax.with_sharding_constraint(a, sharding)
+        return a, gperm
+
+    a, gperm = jax.lax.fori_loop(0, nb, body, (a, perm0))
+    return a, gperm
 
 
 @functools.partial(jax.jit, static_argnames=("block", "sharding"))
@@ -180,10 +278,16 @@ def _mode_to_local(mode: str, n: int) -> bool:
     raise ValueError(f"unknown factorization mode: {mode}")
 
 
-def lu_decompose(mat, mode: str = "auto", block_size: int | None = None):
+def lu_decompose(mat, mode: str = "auto", block_size: int | None = None,
+                 pivot: str = "block"):
     """Block LU with partial pivoting (DenseVecMatrix.luDecompose,
     DenseVecMatrix.scala:283-466). Returns ``(L, U, perm)`` where ``perm`` is
-    the row-permutation vector: ``A[perm] == L @ U``."""
+    the row-permutation vector: ``A[perm] == L @ U``.
+
+    ``pivot``: "block" restricts pivot search to the b×b pivot block (the
+    reference's choice — fast, weaker on adversarial inputs); "panel" searches
+    the full trailing column per elimination step (LAPACK getrf behavior —
+    handles e.g. a singular pivot block with good pivots below it)."""
     _require_square(mat)
     n = mat.num_rows()
     a = mat.logical()
@@ -198,7 +302,12 @@ def lu_decompose(mat, mode: str = "auto", block_size: int | None = None):
     n_pad = pad_to_multiple(n, b)
     a_pad = _pad_with_identity(a, n_pad)
     sharding = NamedSharding(mat.mesh, mat.spec) if n_pad % _grid(mat) == 0 else None
-    lu_pad, perm = _blocked_lu(a_pad, b, sharding)
+    if pivot == "panel":
+        lu_pad, perm = _blocked_lu_panel_pivot(a_pad, b, sharding)
+    elif pivot == "block":
+        lu_pad, perm = _blocked_lu(a_pad, b, sharding)
+    else:
+        raise ValueError(f"unknown pivot strategy: {pivot!r} (block|panel)")
     lu_log = lu_pad[:n, :n]
     l = jnp.tril(lu_log, -1) + jnp.eye(n, dtype=a.dtype)
     u = jnp.triu(lu_log)

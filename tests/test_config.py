@@ -1,0 +1,52 @@
+"""Config knob coverage (the reference's three config channels consolidated —
+SURVEY.md §5.6)."""
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+from marlin_tpu.config import config_context, get_config, set_config
+
+
+def test_set_and_context():
+    base = get_config().broadcast_threshold_mb
+    with config_context(broadcast_threshold_mb=7.0):
+        assert get_config().broadcast_threshold_mb == 7.0
+        with config_context(broadcast_threshold_mb=1.0):
+            assert get_config().broadcast_threshold_mb == 1.0
+        assert get_config().broadcast_threshold_mb == 7.0
+    assert get_config().broadcast_threshold_mb == base
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(AttributeError):
+        set_config(bogus_knob=1)
+
+
+def test_broadcast_threshold_drives_dispatch(mesh):
+    # tiny threshold forces the RMM path even for small operands
+    rng = np.random.default_rng(0)
+    a = mt.BlockMatrix.from_array(rng.standard_normal((32, 32)).astype(np.float32), mesh)
+    b = mt.BlockMatrix.from_array(rng.standard_normal((32, 32)).astype(np.float32), mesh)
+    with config_context(broadcast_threshold_mb=1e-9):
+        out = a.multiply(b)  # auto -> rmm
+    np.testing.assert_allclose(out.to_numpy(), a.to_numpy() @ b.to_numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_block_size_knob_changes_lu(mesh):
+    rng = np.random.default_rng(1)
+    n = 24
+    arr = (rng.standard_normal((n, n)) + n * np.eye(n)).astype(np.float32)
+    m = mt.BlockMatrix.from_array(arr, mesh)
+    with config_context(lu_base_size=6):
+        l, u, p = m.lu_decompose(mode="dist")
+    np.testing.assert_allclose(arr[p], l.to_numpy() @ u.to_numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_default_dtype_knob(mesh):
+    import jax.numpy as jnp
+
+    with config_context(default_dtype=jnp.bfloat16):
+        m = mt.DenseVecMatrix.random(0, 8, 8, mesh=mesh)
+        assert m.dtype == jnp.bfloat16

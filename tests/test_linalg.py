@@ -93,3 +93,34 @@ def test_svd_no_u(mesh):
                                                             compute_u=False)
     assert res.u is None
     np.testing.assert_allclose(res.s, np.linalg.svd(a, compute_uv=False)[:3], rtol=2e-2)
+
+
+@pytest.mark.parametrize("block", [8, 5])
+def test_lu_panel_pivot(mesh, block):
+    n = 24
+    a = _well_conditioned(n, 7)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    l, u, p = mt.linalg.lu_decompose(m, mode="dist", block_size=block, pivot="panel")
+    np.testing.assert_allclose(a[p], l.to_numpy() @ u.to_numpy(), rtol=1e-3, atol=1e-3)
+    assert np.allclose(l.to_numpy(), np.tril(l.to_numpy()))
+    # multipliers bounded by 1 — the signature of true partial pivoting
+    assert np.abs(np.tril(l.to_numpy(), -1)).max() <= 1.0 + 1e-5
+
+
+def test_lu_panel_pivot_beats_block_pivot(mesh):
+    # pivot block entirely zero, good pivots below it: block-local pivoting
+    # cannot factor this; full-height panel pivoting must
+    n, b = 8, 4
+    a = np.zeros((n, n), np.float32)
+    a[:b, b:] = np.eye(b)        # upper-right identity
+    a[b:, :b] = np.eye(b)        # lower-left identity
+    a[b:, b:] = 0.5 * np.eye(b)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    l, u, p = mt.linalg.lu_decompose(m, mode="dist", block_size=b, pivot="panel")
+    np.testing.assert_allclose(a[p], l.to_numpy() @ u.to_numpy(), atol=1e-5)
+
+
+def test_lu_bad_pivot_arg(mesh):
+    m = mt.BlockMatrix.from_array(np.eye(8, dtype=np.float32), mesh)
+    with pytest.raises(ValueError):
+        mt.linalg.lu_decompose(m, mode="dist", block_size=4, pivot="bogus")

@@ -1,0 +1,73 @@
+"""Linear-system solves on distributed matrices.
+
+The reference stops at the factorizations (its ALS solves tiny rank×rank
+systems locally and its `inverse` exists mainly to substitute for solve —
+ALSHelp.scala:388-392 even inverts explicitly). A factorization API without a
+solve API forces users into explicit inverses, so the rebuild closes the gap:
+
+- :func:`lu_solve` — reuse an ``(L, U, perm)`` from :func:`lu_decompose`
+  against one or many right-hand sides (two sharded triangular solves).
+- :func:`solve` — factor-and-solve convenience with the same mode knobs.
+
+Triangular solves lower to XLA's blocked TriangularSolve, which schedules fine
+on TPU; no explicit inverse is ever formed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .factorizations import _mode_to_local, lu_decompose
+
+__all__ = ["lu_solve", "solve"]
+
+
+def _rhs_array(b):
+    arr = b.logical() if hasattr(b, "logical") else jnp.asarray(b)
+    return (arr[:, None], True) if arr.ndim == 1 else (arr, False)
+
+
+@jax.jit
+def _lu_solve_jit(l, u, perm, b):
+    solve_tri = jax.scipy.linalg.solve_triangular
+    pb = b[perm]
+    y = solve_tri(l, pb, lower=True, unit_diagonal=True)
+    return solve_tri(u, y, lower=False)
+
+
+def lu_solve(l, u, perm, b):
+    """Solve ``A x = b`` given ``A[perm] = L U`` from :func:`lu_decompose`.
+    ``b``: vector, matrix, or distributed matrix/vector; returns an array of
+    the same logical shape."""
+    l_arr = l.logical() if hasattr(l, "logical") else jnp.asarray(l)
+    u_arr = u.logical() if hasattr(u, "logical") else jnp.asarray(u)
+    rhs, was_vector = _rhs_array(b)
+    if rhs.shape[0] != l_arr.shape[0]:
+        raise ValueError(
+            f"rhs has {rhs.shape[0]} rows, factorization is {l_arr.shape[0]}"
+        )
+    x = _lu_solve_jit(l_arr, u_arr, jnp.asarray(np.asarray(perm)), rhs)
+    return x[:, 0] if was_vector else x
+
+
+def solve(mat, b, mode: str = "auto", pivot: str = "block",
+          block_size: int | None = None):
+    """Solve ``mat @ x = b``. Small systems go through the fused local path
+    (``jnp.linalg.solve``); large ones factor with the blocked distributed LU
+    (``pivot``/``block_size`` forwarded) and back-substitute — never via an
+    explicit inverse (the fix SURVEY.md §7 flags against ALSHelp.scala:388-392)."""
+    if pivot not in ("block", "panel"):
+        raise ValueError(f"unknown pivot strategy: {pivot!r} (block|panel)")
+    n = mat.num_rows()
+    if mat.num_cols() != n:
+        raise ValueError(f"solve needs a square matrix, got {mat.shape}")
+    rhs, was_vector = _rhs_array(b)
+    if rhs.shape[0] != n:
+        raise ValueError(f"rhs has {rhs.shape[0]} rows, matrix is {n}x{n}")
+    if _mode_to_local(mode, n):
+        x = jnp.linalg.solve(mat.logical(), rhs)
+        return x[:, 0] if was_vector else x
+    l, u, perm = lu_decompose(mat, mode=mode, pivot=pivot, block_size=block_size)
+    return lu_solve(l, u, perm, b)

@@ -124,3 +124,41 @@ def test_lu_bad_pivot_arg(mesh):
     m = mt.BlockMatrix.from_array(np.eye(8, dtype=np.float32), mesh)
     with pytest.raises(ValueError):
         mt.linalg.lu_decompose(m, mode="dist", block_size=4, pivot="bogus")
+
+
+@pytest.mark.parametrize("mode", ["local", "dist"])
+def test_solve(mesh, mode):
+    n = 20
+    a = _well_conditioned(n, 9)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    rng = np.random.default_rng(10)
+    b_vec = rng.standard_normal(n).astype(np.float32)
+    b_mat = rng.standard_normal((n, 3)).astype(np.float32)
+    x = mt.linalg.solve(m, b_vec, mode=mode)
+    np.testing.assert_allclose(a @ np.asarray(x), b_vec, rtol=1e-2, atol=1e-3)
+    xm = mt.linalg.solve(m, b_mat, mode=mode)
+    np.testing.assert_allclose(a @ np.asarray(xm), b_mat, rtol=1e-2, atol=1e-3)
+
+
+def test_lu_solve_reuses_factorization(mesh):
+    n = 16
+    a = _well_conditioned(n, 11)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    l, u, p = mt.linalg.lu_decompose(m, mode="dist", block_size=8)
+    for seed in (0, 1):
+        b = np.random.default_rng(seed).standard_normal(n).astype(np.float32)
+        x = mt.linalg.lu_solve(l, u, p, b)
+        np.testing.assert_allclose(a @ np.asarray(x), b, rtol=1e-2, atol=1e-3)
+    with pytest.raises(ValueError):
+        mt.linalg.lu_solve(l, u, p, np.ones(5, np.float32))
+
+
+def test_solve_validates_pivot_early(mesh):
+    m = mt.BlockMatrix.from_array(np.eye(8, dtype=np.float32), mesh)
+    with pytest.raises(ValueError):
+        mt.linalg.solve(m, np.ones(8, np.float32), mode="local", pivot="bogus")
+    # block_size forwarded to the dist factorization
+    a = _well_conditioned(16, 13)
+    x = mt.linalg.solve(mt.BlockMatrix.from_array(a, mesh),
+                        np.ones(16, np.float32), mode="dist", block_size=4)
+    np.testing.assert_allclose(a @ np.asarray(x), np.ones(16), rtol=1e-2, atol=1e-3)

@@ -52,7 +52,9 @@ from jax.sharding import NamedSharding
 from ..config import get_config
 from ..mesh import pad_to_multiple
 
-__all__ = ["lu_decompose", "cholesky_decompose", "inverse"]
+__all__ = ["lu_decompose", "cholesky_decompose", "inverse", "PIVOT_STRATEGIES"]
+
+PIVOT_STRATEGIES = ("block", "panel")
 
 
 def _pad_with_identity(a: jax.Array, n_pad: int) -> jax.Array:
@@ -302,12 +304,12 @@ def lu_decompose(mat, mode: str = "auto", block_size: int | None = None,
     n_pad = pad_to_multiple(n, b)
     a_pad = _pad_with_identity(a, n_pad)
     sharding = NamedSharding(mat.mesh, mat.spec) if n_pad % _grid(mat) == 0 else None
-    if pivot == "panel":
-        lu_pad, perm = _blocked_lu_panel_pivot(a_pad, b, sharding)
-    elif pivot == "block":
-        lu_pad, perm = _blocked_lu(a_pad, b, sharding)
-    else:
-        raise ValueError(f"unknown pivot strategy: {pivot!r} (block|panel)")
+    if pivot not in PIVOT_STRATEGIES:
+        raise ValueError(
+            f"unknown pivot strategy: {pivot!r} (one of {PIVOT_STRATEGIES})"
+        )
+    factor = _blocked_lu_panel_pivot if pivot == "panel" else _blocked_lu
+    lu_pad, perm = factor(a_pad, b, sharding)
     lu_log = lu_pad[:n, :n]
     l = jnp.tril(lu_log, -1) + jnp.eye(n, dtype=a.dtype)
     u = jnp.triu(lu_log)

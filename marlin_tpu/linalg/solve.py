@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .factorizations import _mode_to_local, lu_decompose
+from .factorizations import PIVOT_STRATEGIES, _mode_to_local, lu_decompose
 
 __all__ = ["lu_solve", "solve"]
 
@@ -58,8 +58,10 @@ def solve(mat, b, mode: str = "auto", pivot: str = "block",
     (``jnp.linalg.solve``); large ones factor with the blocked distributed LU
     (``pivot``/``block_size`` forwarded) and back-substitute — never via an
     explicit inverse (the fix SURVEY.md §7 flags against ALSHelp.scala:388-392)."""
-    if pivot not in ("block", "panel"):
-        raise ValueError(f"unknown pivot strategy: {pivot!r} (block|panel)")
+    if pivot not in PIVOT_STRATEGIES:
+        raise ValueError(
+            f"unknown pivot strategy: {pivot!r} (one of {PIVOT_STRATEGIES})"
+        )
     n = mat.num_rows()
     if mat.num_cols() != n:
         raise ValueError(f"solve needs a square matrix, got {mat.shape}")

@@ -344,15 +344,22 @@ class DenseMatrix(DistributedMatrix):
     def transpose(self):
         return self._wrap(self.logical().T)
 
+    def _bind(self, other, axis: int, label: str):
+        other_arr = other.logical() if isinstance(other, DenseMatrix) else jnp.asarray(other)
+        if other_arr.shape[1 - axis] != self._shape[1 - axis]:
+            raise ValueError(
+                f"{label}: {'row' if axis == 1 else 'column'} count mismatch"
+            )
+        return self._wrap(jnp.concatenate([self.logical(), other_arr], axis=axis))
+
     def c_bind(self, other):
         """Column concatenation (DenseVecMatrix.cBind, DenseVecMatrix.scala:238-252)."""
-        if isinstance(other, DenseMatrix):
-            other_arr = other.logical()
-        else:
-            other_arr = jnp.asarray(other)
-        if other_arr.shape[0] != self.num_rows():
-            raise ValueError("cBind: row count mismatch")
-        return self._wrap(jnp.concatenate([self.logical(), other_arr], axis=1))
+        return self._bind(other, axis=1, label="cBind")
+
+    def r_bind(self, other):
+        """Row concatenation — the natural pair of cBind (the reference stops
+        at cBind; DistributedMatrix.scala:62)."""
+        return self._bind(other, axis=0, label="rBind")
 
     def slice_by_row(self, start_row: int, end_row: int):
         """Inclusive row range (DenseVecMatrix.sliceByRow, :928-939)."""

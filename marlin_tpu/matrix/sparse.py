@@ -97,6 +97,19 @@ class CoordinateMatrix:
     def to_numpy(self) -> np.ndarray:
         return np.asarray(jax.device_get(self.to_dense()))
 
+    def save_to_file_system(self, path: str):
+        """Write ``i j v`` COO text — the same format load_coordinate_matrix
+        parses (the reference ships a loader but no writer)."""
+        import os
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        ri = np.asarray(self.row_indices)
+        ci = np.asarray(self.col_indices)
+        vals = np.asarray(self.values)
+        with open(path, "w") as f:
+            for i, j, v in zip(ri, ci, vals):
+                f.write(f"{int(i)} {int(j)} {float(v)!r}\n")
+
     def als(self, rank: int, iterations: int = 10, lam: float = 0.01, seed: int = 0,
             **kwargs):
         """Alternating least squares on these ratings (CoordinateMatrix.ALS,

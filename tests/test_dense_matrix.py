@@ -233,3 +233,11 @@ def test_getitem_bounds_checked(mesh, a4):
     with pytest.raises(IndexError):
         m[0, -5]
     assert float(m[-1, -1]) == a4[-1, -1]  # negative indexing still works
+
+
+def test_rbind(mesh, a4, b4):
+    out = mt.DenseVecMatrix.from_array(a4, mesh).r_bind(
+        mt.BlockMatrix.from_array(b4, mesh))
+    assert_close(out, np.concatenate([a4, b4], axis=0))
+    with pytest.raises(ValueError):
+        mt.DenseVecMatrix.from_array(a4, mesh).r_bind(np.ones((2, 5), np.float32))

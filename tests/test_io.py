@@ -97,3 +97,12 @@ def test_training_checkpoint(tmp_path):
     restored, step = load_checkpoint(state, str(tmp_path / "t"))
     assert step == 7
     np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(state["w"]))
+
+
+def test_coordinate_save_roundtrip(tmp_path, mesh):
+    coo = mt.CoordinateMatrix.from_entries(
+        [(0, 1, 1.5), (2, 0, -2.25), (3, 3, 0.125)], mesh=mesh)
+    p = str(tmp_path / "coo_out.txt")
+    coo.save_to_file_system(p)
+    back = mt.load_coordinate_matrix(p, shape=coo.shape, mesh=mesh)
+    np.testing.assert_allclose(back.to_numpy(), coo.to_numpy())

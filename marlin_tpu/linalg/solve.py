@@ -7,6 +7,8 @@ solve API forces users into explicit inverses, so the rebuild closes the gap:
 
 - :func:`lu_solve` — reuse an ``(L, U, perm)`` from :func:`lu_decompose`
   against one or many right-hand sides (two sharded triangular solves).
+- :func:`cholesky_solve` — the SPD counterpart, reusing ``L`` from
+  :func:`cholesky_decompose`.
 - :func:`solve` — factor-and-solve convenience with the same mode knobs.
 
 Triangular solves lower to XLA's blocked TriangularSolve, which schedules fine
@@ -21,12 +23,24 @@ import numpy as np
 
 from .factorizations import PIVOT_STRATEGIES, _mode_to_local, lu_decompose
 
-__all__ = ["lu_solve", "solve"]
+__all__ = ["lu_solve", "cholesky_solve", "solve"]
 
 
 def _rhs_array(b):
     arr = b.logical() if hasattr(b, "logical") else jnp.asarray(b)
     return (arr[:, None], True) if arr.ndim == 1 else (arr, False)
+
+
+def _factor_and_rhs(factor, b):
+    """Shared coercion/validation for the factor-reuse solvers: returns
+    (factor array, 2-D rhs, was_vector)."""
+    f_arr = factor.logical() if hasattr(factor, "logical") else jnp.asarray(factor)
+    rhs, was_vector = _rhs_array(b)
+    if rhs.shape[0] != f_arr.shape[0]:
+        raise ValueError(
+            f"rhs has {rhs.shape[0]} rows, factorization is {f_arr.shape[0]}"
+        )
+    return f_arr, rhs, was_vector
 
 
 @jax.jit
@@ -41,14 +55,24 @@ def lu_solve(l, u, perm, b):
     """Solve ``A x = b`` given ``A[perm] = L U`` from :func:`lu_decompose`.
     ``b``: vector, matrix, or distributed matrix/vector; returns an array of
     the same logical shape."""
-    l_arr = l.logical() if hasattr(l, "logical") else jnp.asarray(l)
+    l_arr, rhs, was_vector = _factor_and_rhs(l, b)
     u_arr = u.logical() if hasattr(u, "logical") else jnp.asarray(u)
-    rhs, was_vector = _rhs_array(b)
-    if rhs.shape[0] != l_arr.shape[0]:
-        raise ValueError(
-            f"rhs has {rhs.shape[0]} rows, factorization is {l_arr.shape[0]}"
-        )
     x = _lu_solve_jit(l_arr, u_arr, jnp.asarray(np.asarray(perm)), rhs)
+    return x[:, 0] if was_vector else x
+
+
+@jax.jit
+def _chol_solve_jit(l, b):
+    solve_tri = jax.scipy.linalg.solve_triangular
+    y = solve_tri(l, b, lower=True)
+    return solve_tri(l.T, y, lower=False)
+
+
+def cholesky_solve(l, b):
+    """Solve ``A x = b`` given ``A = L Lᵀ`` from :func:`cholesky_decompose`
+    (two triangular solves; the SPD counterpart of :func:`lu_solve`)."""
+    l_arr, rhs, was_vector = _factor_and_rhs(l, b)
+    x = _chol_solve_jit(l_arr, rhs)
     return x[:, 0] if was_vector else x
 
 

@@ -162,3 +162,19 @@ def test_solve_validates_pivot_early(mesh):
     x = mt.linalg.solve(mt.BlockMatrix.from_array(a, mesh),
                         np.ones(16, np.float32), mode="dist", block_size=4)
     np.testing.assert_allclose(a @ np.asarray(x), np.ones(16), rtol=1e-2, atol=1e-3)
+
+
+def test_cholesky_solve(mesh):
+    n = 18
+    a = _spd(n, 15)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    l = m.cholesky_decompose(mode="dist", )
+    rng = np.random.default_rng(16)
+    b = rng.standard_normal(n).astype(np.float32)
+    x = mt.linalg.cholesky_solve(l, b)
+    np.testing.assert_allclose(a @ np.asarray(x), b, rtol=1e-2, atol=1e-2)
+    bm = rng.standard_normal((n, 2)).astype(np.float32)
+    xm = mt.linalg.cholesky_solve(l, bm)
+    np.testing.assert_allclose(a @ np.asarray(xm), bm, rtol=1e-2, atol=1e-2)
+    with pytest.raises(ValueError):
+        mt.linalg.cholesky_solve(l, np.ones(3, np.float32))

@@ -26,15 +26,20 @@ from .factorizations import PIVOT_STRATEGIES, _mode_to_local, lu_decompose
 __all__ = ["lu_solve", "cholesky_solve", "solve"]
 
 
+def _as_array(x):
+    """Distributed matrix/vector or plain array → jax array."""
+    return x.logical() if hasattr(x, "logical") else jnp.asarray(x)
+
+
 def _rhs_array(b):
-    arr = b.logical() if hasattr(b, "logical") else jnp.asarray(b)
+    arr = _as_array(b)
     return (arr[:, None], True) if arr.ndim == 1 else (arr, False)
 
 
 def _factor_and_rhs(factor, b):
     """Shared coercion/validation for the factor-reuse solvers: returns
     (factor array, 2-D rhs, was_vector)."""
-    f_arr = factor.logical() if hasattr(factor, "logical") else jnp.asarray(factor)
+    f_arr = _as_array(factor)
     rhs, was_vector = _rhs_array(b)
     if rhs.shape[0] != f_arr.shape[0]:
         raise ValueError(
@@ -56,7 +61,7 @@ def lu_solve(l, u, perm, b):
     ``b``: vector, matrix, or distributed matrix/vector; returns an array of
     the same logical shape."""
     l_arr, rhs, was_vector = _factor_and_rhs(l, b)
-    u_arr = u.logical() if hasattr(u, "logical") else jnp.asarray(u)
+    u_arr = _as_array(u)
     x = _lu_solve_jit(l_arr, u_arr, jnp.asarray(np.asarray(perm)), rhs)
     return x[:, 0] if was_vector else x
 

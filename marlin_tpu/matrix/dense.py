@@ -470,6 +470,12 @@ class DenseMatrix(DistributedMatrix):
 
         return compute_svd(self, k, mode=mode, **kwargs)
 
+    def solve(self, b, mode: str = "auto", **kwargs):
+        """Solve ``self @ x = b`` (marlin_tpu.linalg.solve)."""
+        from ..linalg import solve
+
+        return solve(self, b, mode=mode, **kwargs)
+
     # --------------------------------------------------------------- training
     def lr(self, step_size: float, iters: int) -> np.ndarray:
         """Full-batch logistic-gradient descent over rows of (label, features)

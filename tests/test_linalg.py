@@ -178,3 +178,12 @@ def test_cholesky_solve(mesh):
     np.testing.assert_allclose(a @ np.asarray(xm), bm, rtol=1e-2, atol=1e-2)
     with pytest.raises(ValueError):
         mt.linalg.cholesky_solve(l, np.ones(3, np.float32))
+
+
+def test_matrix_solve_method(mesh):
+    n = 12
+    a = _well_conditioned(n, 17)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    b = np.random.default_rng(18).standard_normal(n).astype(np.float32)
+    x = m.solve(b)
+    np.testing.assert_allclose(a @ np.asarray(x), b, rtol=1e-2, atol=1e-3)

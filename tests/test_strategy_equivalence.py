@@ -1,0 +1,78 @@
+"""Cross-strategy equivalence sweep: every multiply engine must agree with
+every other on the same inputs — the invariant behind the adaptive dispatch
+(the reference only ever compares one RMM variant at a time; here agreement is
+enforced as a property over shapes, layouts, and precisions)."""
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+
+SHAPES = [(16, 16, 16), (33, 17, 9), (8, 64, 8), (50, 3, 41)]
+
+
+@pytest.mark.parametrize("mkn", SHAPES)
+def test_all_strategies_agree(mesh, mkn):
+    m, k, n = mkn
+    rng = np.random.default_rng(sum(mkn))
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    b = rng.standard_normal((k, n)).astype(np.float32)
+    ma = mt.BlockMatrix.from_array(a, mesh)
+    mb = mt.BlockMatrix.from_array(b, mesh)
+    oracle = a @ b
+    results = {
+        s: ma.multiply(mb, strategy=s).to_numpy()
+        for s in ("broadcast", "rmm", "gspmd", "ring")
+    }
+    for name, out in results.items():
+        np.testing.assert_allclose(out, oracle, rtol=1e-3, atol=1e-3, err_msg=name)
+
+
+def test_precision_passthrough(mesh):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((64, 512)).astype(np.float32)
+    b = rng.standard_normal((512, 64)).astype(np.float32)
+    ma = mt.BlockMatrix.from_array(a, mesh)
+    mb = mt.BlockMatrix.from_array(b, mesh)
+    # different strategies accumulate in different orders, so compare each to
+    # the f64 oracle (not to each other — f32 reassociation at k=512 gives
+    # ~1e-4 legitimate divergence between engines)
+    oracle = a.astype(np.float64) @ b.astype(np.float64)
+    for s in ("broadcast", "rmm", "gspmd"):
+        out = ma.multiply(mb, strategy=s, precision="highest").to_numpy()
+        np.testing.assert_allclose(out, oracle, rtol=1e-3, atol=1e-3, err_msg=s)
+
+
+@pytest.mark.parametrize("klass", ["DenseVecMatrix", "BlockMatrix"])
+def test_svd_layout_invariance(mesh, klass):
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((40, 12)).astype(np.float32)
+    m = getattr(mt, klass).from_array(a, mesh)
+    res = m.compute_svd(3, mode="local-eigs")
+    np.testing.assert_allclose(res.s, np.linalg.svd(a, compute_uv=False)[:3],
+                               rtol=2e-2)
+
+
+def test_block_format_uneven_grid(tmp_path, mesh):
+    # block save/load with shapes that don't divide the mesh
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((11, 7)).astype(np.float32)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    p = str(tmp_path / "blk.txt")
+    m.save_to_file_system(p, fmt="block")
+    back = mt.load_block_matrix_file(p, mesh)
+    np.testing.assert_allclose(back.to_numpy(), a, rtol=1e-6, atol=1e-6)
+
+
+def test_chained_mixed_strategies(mesh):
+    # (A @ B) via ring, then @ C via rmm, then elementwise — results compose
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((24, 18)).astype(np.float32)
+    b = rng.standard_normal((18, 30)).astype(np.float32)
+    c = rng.standard_normal((30, 10)).astype(np.float32)
+    ma = mt.DenseVecMatrix.from_array(a, mesh)
+    ab = ma.multiply(mt.DenseVecMatrix.from_array(b, mesh), strategy="ring")
+    abc = ab.multiply(mt.BlockMatrix.from_array(c, mesh), strategy="rmm")
+    final = abc.add(1.0).multiply(0.5)
+    np.testing.assert_allclose(final.to_numpy(), (a @ b @ c + 1.0) * 0.5,
+                               rtol=1e-3, atol=1e-3)

@@ -26,6 +26,12 @@ def test_all_strategies_agree(mesh, mkn):
     }
     for name, out in results.items():
         np.testing.assert_allclose(out, oracle, rtol=1e-3, atol=1e-3, err_msg=name)
+    # pairwise: engines may reassociate f32 sums differently, but at these
+    # contraction depths they must stay within a few ulps of each other
+    base = results["broadcast"]
+    for name, out in results.items():
+        np.testing.assert_allclose(out, base, rtol=5e-4, atol=5e-4,
+                                   err_msg=f"broadcast vs {name}")
 
 
 def test_precision_passthrough(mesh):
@@ -34,13 +40,16 @@ def test_precision_passthrough(mesh):
     b = rng.standard_normal((512, 64)).astype(np.float32)
     ma = mt.BlockMatrix.from_array(a, mesh)
     mb = mt.BlockMatrix.from_array(b, mesh)
-    # different strategies accumulate in different orders, so compare each to
-    # the f64 oracle (not to each other — f32 reassociation at k=512 gives
-    # ~1e-4 legitimate divergence between engines)
+    # the precision kwarg must be accepted by every engine and keep results at
+    # f32-accumulation accuracy vs the f64 oracle (~4e-5 measured at k=512;
+    # 2e-4 bound leaves headroom for reassociation). NOTE: on the CPU test
+    # mesh all precisions compute in f32, so a *dropped* precision kwarg is
+    # only detectable on TPU — the on-chip benches cover that half.
     oracle = a.astype(np.float64) @ b.astype(np.float64)
-    for s in ("broadcast", "rmm", "gspmd"):
+    scale = np.abs(oracle).max()
+    for s in ("broadcast", "rmm", "gspmd", "ring"):
         out = ma.multiply(mb, strategy=s, precision="highest").to_numpy()
-        np.testing.assert_allclose(out, oracle, rtol=1e-3, atol=1e-3, err_msg=s)
+        assert np.abs(out - oracle).max() / scale < 2e-4, s
 
 
 @pytest.mark.parametrize("klass", ["DenseVecMatrix", "BlockMatrix"])

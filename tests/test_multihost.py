@@ -18,8 +18,9 @@ proc_id = int(sys.argv[1])
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax
 jax.config.update("jax_platforms", "cpu")
+NPROC = %NPROC%
 jax.distributed.initialize(coordinator_address="127.0.0.1:%PORT%",
-                           num_processes=2, process_id=proc_id)
+                           num_processes=NPROC, process_id=proc_id)
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -44,8 +45,24 @@ expected_total = float((a_np @ b_np).sum())
 total = float(jax.jit(jnp.sum)(c))  # cross-process psum under the hood
 assert abs(total - expected_total) < 1e-4, (total, expected_total)
 print(f"proc {proc_id}: global sum ok ({total:.4f})", flush=True)
-# skip jax.distributed.shutdown(): Gloo teardown hangs intermittently; a
-# clean process exit is sufficient and what the timeout guard needs
+
+# Ordered shutdown: the coordinator (proc 0) must outlive the workers — if it
+# dies first, the survivors' coordination-service poll thread fatals on
+# "Socket closed". Workers drop a done-file and exit immediately; the
+# coordinator waits for every done-file plus a grace period, then exits.
+# (jax.distributed.shutdown() itself is avoided: its Gloo teardown hangs
+# intermittently.)
+import time
+barrier_dir = r"%BARRIER%"
+if proc_id != 0:
+    open(os.path.join(barrier_dir, f"done_{proc_id}"), "w").close()
+    os._exit(0)
+deadline = time.time() + 60
+while time.time() < deadline:
+    if all(os.path.exists(os.path.join(barrier_dir, f"done_{r}")) for r in range(1, NPROC)):
+        break
+    time.sleep(0.05)
+time.sleep(0.5)  # let worker processes fully terminate before the socket closes
 os._exit(0)
 """
 
@@ -59,7 +76,12 @@ def test_two_process_mesh(tmp_path):
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER.replace("%PORT%", str(port)))
+    nproc = 2
+    script.write_text(
+        _WORKER.replace("%PORT%", str(port))
+        .replace("%BARRIER%", str(tmp_path))
+        .replace("%NPROC%", str(nproc))
+    )
     env = dict(os.environ)
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + \
         os.pathsep + env.get("PYTHONPATH", "")
@@ -67,7 +89,7 @@ def test_two_process_mesh(tmp_path):
         subprocess.Popen([sys.executable, str(script), str(i)],
                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                          text=True, env=env)
-        for i in range(2)
+        for i in range(nproc)
     ]
     outs = []
     for p in procs:

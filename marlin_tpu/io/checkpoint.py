@@ -99,6 +99,13 @@ def load_checkpoint(state_like, path: str, step: int | None = None):
             step = int(f.read().strip())
     data = np.load(os.path.join(path, f"ckpt_{step:08d}.npz"))
     leaves, treedef = jax.tree.flatten(state_like)
+    n_stored = sum(1 for k in data.files if k.startswith("leaf_"))
+    if n_stored != len(leaves):
+        raise ValueError(
+            f"checkpoint at {path} step {step} has {n_stored} leaves but the "
+            f"template expects {len(leaves)} — the checkpoint belongs to a "
+            "different configuration"
+        )
     new_leaves = []
     for i, tmpl in enumerate(leaves):
         arr = data[f"leaf_{i}"]

@@ -101,17 +101,36 @@ def _blocks_from_lines(lines):
         return np.zeros((0, 0))
     nbr = max(k[0] for k in blocks) + 1
     nbc = max(k[1] for k in blocks) + 1
-    row_heights = [blocks[(i, 0)].shape[0] for i in range(nbr)]
-    col_widths = [blocks[(0, j)].shape[1] for j in range(nbc)]
+    # derive extents from ANY present block in each grid row/column (a writer
+    # may omit interior all-zero blocks), and fail descriptively when a whole
+    # grid row or column is absent rather than KeyError-ing on (i, 0)/(0, j).
+    # Caveat: a TRAILING all-zero grid row/column is indistinguishable from a
+    # smaller matrix (the format carries no global shape), so writers must
+    # emit at least one block in the last grid row and column.
+    row_heights = [None] * nbr
+    col_widths = [None] * nbc
+    for (i, j), b in blocks.items():
+        row_heights[i] = b.shape[0]
+        col_widths[j] = b.shape[1]
+    missing_r = [i for i, h in enumerate(row_heights) if h is None]
+    missing_c = [j for j, w in enumerate(col_widths) if w is None]
+    if missing_r or missing_c:
+        raise ValueError(
+            "block text file has no blocks at all in grid "
+            f"row(s) {missing_r} / column(s) {missing_c} — extents are "
+            "unrecoverable; the file is truncated or not block-text format"
+        )
     out = np.zeros((sum(row_heights), sum(col_widths)))
-    r0 = 0
-    for i in range(nbr):
-        c0 = 0
-        for j in range(nbc):
-            b = blocks[(i, j)]
-            out[r0 : r0 + b.shape[0], c0 : c0 + b.shape[1]] = b
-            c0 += b.shape[1]
-        r0 += row_heights[i]
+    row_offs = np.concatenate([[0], np.cumsum(row_heights)])
+    col_offs = np.concatenate([[0], np.cumsum(col_widths)])
+    for (i, j), b in blocks.items():
+        if b.shape != (row_heights[i], col_widths[j]):
+            raise ValueError(
+                f"block ({i},{j}) has shape {b.shape}, inconsistent with grid "
+                f"extents ({row_heights[i]}, {col_widths[j]})"
+            )
+        out[row_offs[i] : row_offs[i] + b.shape[0],
+            col_offs[j] : col_offs[j] + b.shape[1]] = b
     return out
 
 

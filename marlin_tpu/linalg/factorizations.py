@@ -43,6 +43,7 @@ nonsingular; block size comes from the config knobs that mirror
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -301,9 +302,8 @@ def lu_decompose(mat, mode: str = "auto", block_size: int | None = None,
 
     b = block_size or get_config().lu_base_size
     b = min(b, n)
-    n_pad = pad_to_multiple(n, b)
+    n_pad, sharding = _pad_and_sharding(mat, n, b)
     a_pad = _pad_with_identity(a, n_pad)
-    sharding = NamedSharding(mat.mesh, mat.spec) if n_pad % _grid(mat) == 0 else None
     if pivot not in PIVOT_STRATEGIES:
         raise ValueError(
             f"unknown pivot strategy: {pivot!r} (one of {PIVOT_STRATEGIES})"
@@ -322,6 +322,16 @@ def _grid(mat) -> int:
     return mat.mesh.shape[ax] if ax is not None else 1
 
 
+def _pad_and_sharding(mat, n: int, block: int):
+    """Padded size + sharding constraint for a blocked factorization.
+
+    Pads to lcm(block, row-shard-count) so the distributed-mode sharding
+    constraint ALWAYS applies — previously a non-dividing padded size silently
+    dropped the constraint and let GSPMD place the loop however it pleased."""
+    n_pad = pad_to_multiple(n, math.lcm(block, _grid(mat)))
+    return n_pad, NamedSharding(mat.mesh, mat.spec)
+
+
 def cholesky_decompose(mat, mode: str = "auto", block_size: int | None = None):
     """Block Cholesky, lower factor (DenseVecMatrix.choleskyDecompose,
     DenseVecMatrix.scala:475-561). Returns L with ``A == L @ Lᵀ``."""
@@ -332,16 +342,17 @@ def cholesky_decompose(mat, mode: str = "auto", block_size: int | None = None):
         return mat._wrap(jnp.linalg.cholesky(a))
     b = block_size or get_config().cholesky_base_size
     b = min(b, n)
-    n_pad = pad_to_multiple(n, b)
+    n_pad, sharding = _pad_and_sharding(mat, n, b)
     a_pad = _pad_with_identity(a, n_pad)
-    sharding = NamedSharding(mat.mesh, mat.spec) if n_pad % _grid(mat) == 0 else None
     l_pad = _blocked_cholesky(a_pad, b, sharding)
     return mat._wrap(l_pad[:n, :n])
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
-def _inverse_via_lu(a: jax.Array, block: int):
-    lu_pad, perm = _blocked_lu(a, block)
+@functools.partial(jax.jit, static_argnames=("block", "pivot", "sharding"))
+def _inverse_via_lu(a: jax.Array, block: int, pivot: str = "block",
+                    sharding=None):
+    factor = _blocked_lu_panel_pivot if pivot == "panel" else _blocked_lu
+    lu_pad, perm = factor(a, block, sharding)
     n = a.shape[0]
     solve = jax.scipy.linalg.solve_triangular
     l = jnp.tril(lu_pad, -1) + jnp.eye(n, dtype=a.dtype)
@@ -351,19 +362,27 @@ def _inverse_via_lu(a: jax.Array, block: int):
     return pa_inv[:, jnp.argsort(perm)][:, :n]  # apply P on the right
 
 
-def inverse(mat, mode: str = "auto", block_size: int | None = None):
+def inverse(mat, mode: str = "auto", block_size: int | None = None,
+            pivot: str = "block"):
     """Matrix inverse (DenseVecMatrix.inverse, DenseVecMatrix.scala:568-764).
     The reference runs a blocked Gauss-Jordan-style forward + backward sweep
     with driver-factorized pivots; here it is blocked LU + two sharded
-    triangular solves in one XLA program."""
+    triangular solves in one XLA program.
+
+    ``pivot`` mirrors :func:`lu_decompose`: "panel" routes through the
+    full-height panel-pivoted LU for ill-conditioned pivot blocks."""
     _require_square(mat)
     n = mat.num_rows()
     a = mat.logical()
     if _mode_to_local(mode, n):
         return mat._wrap(jnp.linalg.inv(a))
+    if pivot not in PIVOT_STRATEGIES:
+        raise ValueError(
+            f"unknown pivot strategy: {pivot!r} (one of {PIVOT_STRATEGIES})"
+        )
     b = block_size or get_config().inverse_base_size
     b = min(b, n)
-    n_pad = pad_to_multiple(n, b)
+    n_pad, sharding = _pad_and_sharding(mat, n, b)
     a_pad = _pad_with_identity(a, n_pad)
-    inv_pad = _inverse_via_lu(a_pad, b)
+    inv_pad = _inverse_via_lu(a_pad, b, pivot, sharding)
     return mat._wrap(inv_pad[:n, :n])

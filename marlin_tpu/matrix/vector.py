@@ -104,6 +104,10 @@ class DistributedVector:
                 return other.data
             return type(self).from_array(other.logical(), self.mesh).data
         arr = jnp.asarray(other)
+        if arr.shape != (self._length,):
+            raise ValueError(
+                f"operand has shape {arr.shape}, expected ({self._length},)"
+            )
         return jnp.pad(arr, (0, self.data.shape[0] - arr.shape[0]))
 
     # ------------------------------------------------------------ arithmetic

@@ -116,8 +116,11 @@ def bsr_from_coo(rows, cols, vals, shape, block_size: int = 128) -> BsrMatrix:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("n_block_rows", "chunk"))
-def _bsr_spmm_chunked(blocks, brows, bcols, b_panels, n_block_rows: int, chunk: int):
+@functools.partial(
+    jax.jit, static_argnames=("n_block_rows", "chunk", "accum_dtype")
+)
+def _bsr_spmm_chunked(blocks, brows, bcols, b_panels, n_block_rows: int,
+                      chunk: int, accum_dtype=jnp.float32):
     nnzb = blocks.shape[0]
     n_chunks = nnzb // chunk  # pre-padded by caller
     bs, p = b_panels.shape[1], b_panels.shape[2]
@@ -127,12 +130,12 @@ def _bsr_spmm_chunked(blocks, brows, bcols, b_panels, n_block_rows: int, chunk: 
         blk = blocks[idx]                       # (chunk, bs, bs)
         panels = b_panels[bcols[idx]]           # (chunk, bs, p) gather
         prod = jnp.einsum("abc,acd->abd", blk, panels,
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=accum_dtype)
         # +1 spill row swallows padding entries routed to row n_block_rows
         out = out + jax.ops.segment_sum(prod, brows[idx], n_block_rows + 1)
         return out, None
 
-    out0 = jnp.zeros((n_block_rows + 1, bs, p), jnp.float32)
+    out0 = jnp.zeros((n_block_rows + 1, bs, p), accum_dtype)
     idxs = jnp.arange(n_chunks * chunk).reshape(n_chunks, chunk)
     out, _ = jax.lax.scan(body, out0, idxs)
     return out[:n_block_rows]
@@ -166,6 +169,14 @@ def bsr_spmm(bsr: BsrMatrix, b, chunk_blocks: int | None = None) -> jax.Array:
         # padding blocks are zero; route them to the spill row anyway
         brows = jnp.pad(brows, (0, pad), constant_values=n_block_rows)
         bcols = jnp.pad(bcols, (0, pad))
+    # accumulate in at least f32, wider when either operand is (advisor
+    # finding: the hard-coded f32 accumulator silently narrowed f64 inputs
+    # relative to the ELL/BCOO paths behind the same multiply(format=...) switch)
+    accum = jnp.promote_types(jnp.promote_types(blocks.dtype, b.dtype),
+                              jnp.float32)
     out = _bsr_spmm_chunked(blocks, brows, bcols, b_panels, n_block_rows,
-                            chunk_blocks)
-    return out.reshape(n_block_rows * bs, p)[:m].astype(b.dtype)
+                            chunk_blocks, accum)
+    # result dtype = natural promotion of the operands, matching the ELL/BCOO
+    # paths (f32 in, f32 out; any f64 operand keeps the result f64)
+    out_dtype = jnp.promote_types(blocks.dtype, b.dtype)
+    return out.reshape(n_block_rows * bs, p)[:m].astype(out_dtype)

@@ -4,6 +4,7 @@ checkpointing. The reference never tests its loaders (SURVEY.md §4)."""
 import os
 
 import numpy as np
+import pytest
 
 import marlin_tpu as mt
 from marlin_tpu.io import (
@@ -106,3 +107,37 @@ def test_coordinate_save_roundtrip(tmp_path, mesh):
     coo.save_to_file_system(p)
     back = mt.load_coordinate_matrix(p, shape=coo.shape, mesh=mesh)
     np.testing.assert_allclose(back.to_numpy(), coo.to_numpy())
+
+
+def test_block_text_missing_first_blocks(tmp_path, mesh):
+    # extents must come from ANY present block per grid row/column — a writer
+    # omitting all-zero blocks (here: the whole first block row/col absent from
+    # position (0,0)) must still load, and a fully-absent grid row must raise
+    # a format error, not a KeyError
+    from marlin_tpu.io.text import _blocks_from_lines
+
+    # grid 2x2, block (0,0) omitted; row 0 extent comes from (0,1), col 0 from (1,0)
+    lines = [
+        "0-1-2-2:5 6 7 8",   # (0,1): column-major 2x2 -> [[5,7],[6,8]]
+        "1-0-2-2:1 2 3 4",   # (1,0)
+        "1-1-2-2:9 10 11 12",
+    ]
+    out = _blocks_from_lines(lines)
+    expect = np.zeros((4, 4))
+    expect[0:2, 2:4] = np.array([[5, 7], [6, 8]])
+    expect[2:4, 0:2] = np.array([[1, 3], [2, 4]])
+    expect[2:4, 2:4] = np.array([[9, 11], [10, 12]])
+    np.testing.assert_allclose(out, expect)
+
+    with pytest.raises(ValueError, match="no blocks at all"):
+        _blocks_from_lines(["1-0-2-2:1 2 3 4"])  # grid row 0 entirely absent
+
+
+def test_checkpoint_leaf_count_validated(tmp_path):
+    import jax.numpy as jnp
+
+    state = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.zeros(3)}
+    save_checkpoint(state, str(tmp_path / "t"), step=1)
+    smaller = {"w": jnp.zeros((2, 3))}
+    with pytest.raises(ValueError, match="leaves"):
+        load_checkpoint(smaller, str(tmp_path / "t"))

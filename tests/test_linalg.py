@@ -187,3 +187,45 @@ def test_matrix_solve_method(mesh):
     b = np.random.default_rng(18).standard_normal(n).astype(np.float32)
     x = m.solve(b)
     np.testing.assert_allclose(a @ np.asarray(x), b, rtol=1e-2, atol=1e-3)
+
+
+def test_inverse_panel_pivot(mesh):
+    # zero pivot block with good pivots below it: block-local pivoting cannot
+    # factor this, so the pivot= plumb-through to inverse() is load-bearing
+    n, b = 8, 4
+    a = np.zeros((n, n), np.float32)
+    a[:b, b:] = np.eye(b)
+    a[b:, :b] = np.eye(b)
+    a[b:, b:] = 0.5 * np.eye(b)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    inv = mt.linalg.inverse(m, mode="dist", block_size=b, pivot="panel")
+    np.testing.assert_allclose(inv.to_numpy() @ a, np.eye(n), atol=1e-4)
+    with pytest.raises(ValueError):
+        mt.linalg.inverse(m, mode="dist", block_size=b, pivot="bogus")
+
+
+def test_factorization_sharding_always_applied(mesh):
+    """A padded size that doesn't divide the row-shard count used to silently
+    drop the sharding constraint; now the pad covers lcm(block, shards) and
+    the dist-mode LU output carries the expected sharding."""
+    import jax.numpy as jnp
+
+    from marlin_tpu.linalg.factorizations import (
+        _blocked_lu,
+        _pad_and_sharding,
+        _pad_with_identity,
+    )
+
+    n, b = 21, 7  # pad-to-block alone gives 21, not divisible by 2 mesh rows
+    a = _well_conditioned(n, 11)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    n_pad, sharding = _pad_and_sharding(m, n, b)
+    assert sharding is not None
+    assert n_pad % b == 0 and n_pad % mesh.shape["rows"] == 0
+
+    lu_pad, _ = _blocked_lu(_pad_with_identity(jnp.asarray(a), n_pad), b, sharding)
+    assert lu_pad.sharding.is_equivalent_to(sharding, lu_pad.ndim)
+
+    # and the public API stays correct at the awkward size
+    l, u, p = mt.linalg.lu_decompose(m, mode="dist", block_size=b)
+    np.testing.assert_allclose(a[p], l.to_numpy() @ u.to_numpy(), rtol=1e-3, atol=1e-3)

@@ -82,3 +82,13 @@ def test_vector_norm_negative_ord(mesh):
     v = mt.DistributedVector.from_array(x, mesh)
     assert float(v.norm(-np.inf)) == pytest.approx(2.0)
     assert float(v.norm(-1)) == pytest.approx(np.linalg.norm(x, -1), rel=1e-5)
+
+
+def test_raw_operand_length_validated(mesh):
+    # a short raw-array operand used to be silently zero-padded to the
+    # sharded length, producing wrong results with no error
+    v = mt.DistributedVector.from_array(np.arange(8, dtype=np.float32), mesh)
+    with pytest.raises(ValueError, match="operand has shape"):
+        v.add(np.ones(3, np.float32))
+    with pytest.raises(ValueError, match="operand has shape"):
+        v.substract(np.ones(11, np.float32))

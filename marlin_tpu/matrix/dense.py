@@ -275,17 +275,43 @@ class DenseMatrix(DistributedMatrix):
         if hasattr(other, "ndim") and other.ndim == 1:
             return self.multiply_vector(DistributedVector.from_array(other, self.mesh))
 
+        from ..parallel.matmul import matmul_padded
+
         if isinstance(other, DenseMatrix):
-            b = other.logical()
+            b_pad, (kb, n) = other.data, other.shape
         else:
-            b = jnp.asarray(other)
-        if self.num_cols() != b.shape[0]:
-            raise ValueError(f"inner dim mismatch: {self.shape} @ {b.shape}")
+            b_pad = jnp.asarray(other)
+            kb, n = b_pad.shape
+        m, k = self.shape
+        if k != kb:
+            raise ValueError(f"inner dim mismatch: {self.shape} @ {(kb, n)}")
         out_spec = P(ROWS, COLS) if self.mesh.shape.get(COLS, 1) > 1 else P(ROWS, None)
+        out_sharding = NamedSharding(self.mesh, out_spec)
+        gr, gc = _grid_divisors(self.mesh, out_spec)
+        out_pad = (pad_to_multiple(m, gr), pad_to_multiple(n, gc))
+        klass = BlockMatrix if out_spec[1] is not None else DenseVecMatrix
+
+        # fused single-dispatch path: padded operands in, padded+sharded
+        # result out — no host-side pad/placement, no from_array round-trip
+        c_pad = matmul_padded(
+            self.data,
+            b_pad,
+            (m, k, n),
+            out_sharding,
+            out_pad,
+            strategy=strategy,
+            split=split,
+            broadcast_threshold_mb=broadcast_threshold_mb,
+            precision=precision,
+        )
+        if c_pad is not None:
+            return klass(c_pad, (m, n), self.mesh, out_spec)
+
+        # legacy logical-array path (ring, or an RMM split over a device subset)
         c = _matmul(
             self.logical(),
-            b,
-            out_sharding=NamedSharding(self.mesh, out_spec),
+            b_pad if not isinstance(other, DenseMatrix) else other.logical(),
+            out_sharding=out_sharding,
             strategy=strategy,
             split=split,
             broadcast_threshold_mb=broadcast_threshold_mb,

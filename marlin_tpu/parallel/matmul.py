@@ -182,6 +182,169 @@ def _size_mb(x: jax.Array) -> float:
     return x.size * x.dtype.itemsize / 1e6
 
 
+_STRATEGIES = ("auto", "broadcast", "broadcast_a", "rmm", "gspmd", "ring")
+
+
+def _resolve_strategy(
+    mkn: tuple[int, int, int],
+    itemsize: int,
+    strategy: str,
+    broadcast_threshold_mb: float | None,
+) -> str:
+    """Shared auto-dispatch (DenseVecMatrix.scala:196-231): broadcast when one
+    operand is under the threshold, else CARMA RMM. Used by both the fused and
+    the legacy entry points so the dispatch can't drift between them."""
+    if strategy not in _STRATEGIES:
+        raise ValueError(
+            f"unknown matmul strategy: {strategy!r} (one of {_STRATEGIES})"
+        )
+    if strategy != "auto":
+        return strategy
+    m, k, n = mkn
+    threshold = (
+        broadcast_threshold_mb
+        if broadcast_threshold_mb is not None
+        else get_config().broadcast_threshold_mb
+    )
+    if k * n * itemsize / 1e6 <= threshold:
+        return "broadcast"
+    if m * k * itemsize / 1e6 <= threshold:
+        return "broadcast_a"
+    return "rmm"
+
+
+@functools.lru_cache(maxsize=128)
+def _fused_fn(
+    strategy: str,
+    mkn: tuple[int, int, int],
+    out_pad: tuple[int, int],
+    out_sharding: NamedSharding,
+    precision: str,
+    accum_dtype,
+    mesh3: Mesh | None,
+    replicate_which: str,
+):
+    """One jitted program for the whole multiply: slice the padded operands to
+    their logical extents, reshard/contract, and emit the result already padded
+    to the OUTPUT matrix's grid and constrained to its sharding.
+
+    This is the round-2 fix for the per-call dispatch overhead the mid-size
+    bench exposed (pads + device_puts outside jit on every call, then a
+    ``from_array`` round-trip on the result): everything between the two padded
+    buffers now lives inside XLA, where resharding is a collective the
+    scheduler can overlap instead of a blocking host-side placement."""
+    m, k, n = mkn
+    mp_out, np_out = out_pad
+
+    def _finish(c):
+        c = jnp.pad(c, ((0, mp_out - m), (0, np_out - n)))
+        return jax.lax.with_sharding_constraint(c, out_sharding)
+
+    if strategy == "rmm":
+        pm, pk, pn = (mesh3.shape[_M], mesh3.shape[_K], mesh3.shape[_N])
+        mp_r, kp_r, np_r = (
+            pad_to_multiple(m, pm), pad_to_multiple(k, pk), pad_to_multiple(n, pn)
+        )
+        sh_a = NamedSharding(mesh3, P(_M, _K))
+        sh_b = NamedSharding(mesh3, P(_K, _N))
+
+        def local(ab, bb):
+            cb = jnp.dot(ab, bb, precision=precision,
+                         preferred_element_type=accum_dtype)
+            return jax.lax.psum(cb, _K)
+
+        @jax.jit
+        def f(a_pad, b_pad):
+            a = jnp.pad(a_pad[:m, :k], ((0, mp_r - m), (0, kp_r - k)))
+            b = jnp.pad(b_pad[:k, :n], ((0, kp_r - k), (0, np_r - n)))
+            a = jax.lax.with_sharding_constraint(a, sh_a)
+            b = jax.lax.with_sharding_constraint(b, sh_b)
+            c = jax.shard_map(
+                local, mesh=mesh3,
+                in_specs=(P(_M, _K), P(_K, _N)), out_specs=P(_M, _N),
+            )(a, b)
+            return _finish(c[:m, :n])
+
+        return f
+
+    if strategy in ("broadcast", "broadcast_a"):
+        repl = NamedSharding(out_sharding.mesh, P())
+
+        @jax.jit
+        def f(a_pad, b_pad):
+            a, b = a_pad[:m, :k], b_pad[:k, :n]
+            if replicate_which == "b":
+                b = jax.lax.with_sharding_constraint(b, repl)
+            else:
+                a = jax.lax.with_sharding_constraint(a, repl)
+            c = jnp.dot(a, b, precision=precision,
+                        preferred_element_type=accum_dtype)
+            return _finish(c)
+
+        return f
+
+    # gspmd: let the SPMD partitioner pick the schedule
+    @jax.jit
+    def f(a_pad, b_pad):
+        c = jnp.dot(a_pad[:m, :k], b_pad[:k, :n], precision=precision,
+                    preferred_element_type=accum_dtype)
+        return _finish(c)
+
+    return f
+
+
+def matmul_padded(
+    a_pad: jax.Array,
+    b_pad: jax.Array,
+    mkn: tuple[int, int, int],
+    out_sharding: NamedSharding,
+    out_pad: tuple[int, int],
+    strategy: str = "auto",
+    split: tuple[int, int, int] | None = None,
+    broadcast_threshold_mb: float | None = None,
+    precision: str | None = None,
+    accum_dtype=None,
+) -> jax.Array | None:
+    """Padded-in / padded-out multiply in ONE dispatch (see :func:`_fused_fn`).
+
+    ``a_pad``/``b_pad`` carry their matrices' zero-padded layouts; ``mkn`` is
+    the logical (m, k, n). Returns the result already padded to ``out_pad`` and
+    sharded as ``out_sharding`` — the caller can construct the result matrix
+    around it directly, with no further placement.
+
+    Returns ``None`` when the requested configuration has no fused program
+    (an RMM split that doesn't fill the mesh — one XLA executable cannot span
+    two different device sets — or the ring strategy, which manages its own
+    placement); callers fall back to the legacy logical-array path."""
+    m, k, n = mkn
+    strategy = _resolve_strategy(
+        mkn, jnp.dtype(b_pad.dtype).itemsize, strategy, broadcast_threshold_mb
+    )
+
+    mesh3 = None
+    if strategy == "rmm":
+        devs = list(out_sharding.mesh.devices.flat)
+        if split is None:
+            split = split_method(m, k, n, len(devs))
+        if split[0] * split[1] * split[2] != len(devs):
+            return None  # subset mesh — not expressible in one executable
+        mesh3 = build_rmm_mesh(split, devs)
+    elif strategy == "ring":
+        return None
+
+    fn = _fused_fn(
+        strategy,
+        (m, k, n),
+        out_pad,
+        out_sharding,
+        _resolve_precision(precision),
+        accum_dtype or a_pad.dtype,
+        mesh3,
+        "a" if strategy == "broadcast_a" else "b",
+    )
+    return fn(a_pad, b_pad)
+
+
 def matmul(
     a: jax.Array,
     b: jax.Array,
@@ -197,23 +360,16 @@ def matmul(
     (DenseVecMatrix.scala:196-231): broadcast when one operand is small,
     otherwise CARMA-split RMM over the mesh.
     """
-    cfg = get_config()
-    threshold = (
-        broadcast_threshold_mb
-        if broadcast_threshold_mb is not None
-        else cfg.broadcast_threshold_mb
-    )
     if out_sharding is None:
         mesh = default_mesh()
         out_sharding = NamedSharding(mesh, P(mesh.axis_names[0], mesh.axis_names[1]))
 
-    if strategy == "auto":
-        if _size_mb(b) <= threshold:
-            strategy = "broadcast"
-        elif _size_mb(a) <= threshold:
-            strategy = "broadcast_a"
-        else:
-            strategy = "rmm"
+    strategy = _resolve_strategy(
+        (a.shape[0], a.shape[1], b.shape[1]),
+        jnp.dtype(b.dtype).itemsize,
+        strategy,
+        broadcast_threshold_mb,
+    )
 
     if strategy == "broadcast":
         return broadcast_matmul(a, b, out_sharding, "b", precision, accum_dtype)

@@ -1,6 +1,8 @@
 """ML workload tests: ALS (untested in the reference — SURVEY.md §4), plus the
 CARMA split heuristic properties."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -32,6 +34,72 @@ def test_als_predict_shape(mesh):
     model = coo.als(rank=2, iterations=5, lam=0.1)
     preds = model.predict([0, 1], [0, 0])
     assert preds.shape == (2,)
+
+
+def _rating_fixture(seed, n_users, n_items, rank, density, mesh):
+    rng = np.random.default_rng(seed)
+    u_true = rng.standard_normal((n_users, rank)).astype(np.float32)
+    v_true = rng.standard_normal((n_items, rank)).astype(np.float32)
+    full = u_true @ v_true.T
+    mask = rng.random((n_users, n_items)) < density
+    ui, ii = np.nonzero(mask)
+    return mt.CoordinateMatrix(ui, ii, full[mask], shape=(n_users, n_items),
+                               mesh=mesh)
+
+
+def test_als_sharded_matches_replicated(mesh):
+    # same init, same data: the mesh-sharded solver must agree with the
+    # replicated one up to FP summation order
+    coo = _rating_fixture(2, 60, 40, 4, 0.5, mesh)
+    rep = coo.als(rank=4, iterations=6, lam=0.05, shard=False)
+    sh = coo.als(rank=4, iterations=6, lam=0.05, shard=True, segment_block=8)
+    np.testing.assert_allclose(sh.user_features.to_numpy(),
+                               rep.user_features.to_numpy(),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(sh.product_features.to_numpy(),
+                               rep.product_features.to_numpy(),
+                               rtol=2e-3, atol=2e-3)
+    assert sh.rmse(coo) < 0.3
+
+
+def test_als_sharded_implicit_matches_replicated(mesh):
+    rng = np.random.default_rng(3)
+    n_users, n_items = 40, 24
+    mask = rng.random((n_users, n_items)) < 0.3
+    ui, ii = np.nonzero(mask)
+    counts = rng.integers(1, 10, len(ui)).astype(np.float32)
+    coo = mt.CoordinateMatrix(ui, ii, counts, shape=(n_users, n_items), mesh=mesh)
+    rep = coo.als(rank=4, iterations=8, lam=0.1, implicit_prefs=True,
+                  alpha=10.0, shard=False)
+    sh = coo.als(rank=4, iterations=8, lam=0.1, implicit_prefs=True,
+                 alpha=10.0, shard=True, segment_block=8)
+    np.testing.assert_allclose(sh.user_features.to_numpy(),
+                               rep.user_features.to_numpy(),
+                               rtol=5e-3, atol=5e-3)
+
+
+@pytest.mark.skipif(not os.environ.get("MARLIN_SCALE_TESTS"),
+                    reason="multi-GB scale run; set MARLIN_SCALE_TESTS=1")
+def test_als_sharded_scale(mesh):
+    # VERDICT round-1 #4 done criterion: 2M users × 200k items × rank 64 on
+    # the 8-device CPU mesh, per-device stat memory bounded by segment_block
+    # (4096·64·64·4 ≈ 67 MB vs the 32 GB full stat tensor), RMSE decreasing.
+    rng = np.random.default_rng(0)
+    n_users, n_items, rank, nnz = 2_000_000, 200_000, 64, 4_000_000
+    ui = rng.integers(0, n_users, nnz).astype(np.int32)
+    ii = rng.integers(0, n_items, nnz).astype(np.int32)
+    vals = rng.standard_normal(nnz).astype(np.float32)
+    coo = mt.CoordinateMatrix(ui, ii, vals, shape=(n_users, n_items), mesh=mesh)
+    model = coo.als(rank=rank, iterations=1, lam=0.1, shard=True)
+    rmse = model.rmse(coo)
+    assert np.isfinite(rmse)
+    # one sweep on pure-noise ratings still must beat the unit-sphere init
+    from marlin_tpu.ml.als import ALSModel
+    init = ALSModel(
+        mt.DenseVecMatrix.from_array(np.ones((n_users, rank), np.float32) / rank, mesh),
+        mt.DenseVecMatrix.from_array(np.ones((n_items, rank), np.float32) / rank, mesh),
+    )
+    assert rmse < init.rmse(coo)
 
 
 def test_carma_split_budget():

@@ -20,7 +20,8 @@ def main(argv=None):
     n = int(argv[2]) if len(argv) > 2 else 8
 
     import marlin_tpu as mt
-    from marlin_tpu.ml import build_transition_matrix, pagerank
+    from marlin_tpu.ml import (build_transition_matrix,
+                               build_transition_operator, pagerank)
 
     mesh = mt.create_mesh()
     if source != "random":
@@ -35,8 +36,12 @@ def main(argv=None):
     else:
         rng = np.random.default_rng(0)
         edges = [(int(s), int(d)) for s, d in rng.integers(0, n, (4 * n, 2)) if s != d]
-    m = build_transition_matrix(edges)
-    link = mt.BlockMatrix.from_array(m, mesh)
+    num_nodes = max(max(s, d) for s, d in edges) + 1
+    if len(edges) > 100_000 or num_nodes > 2_000:
+        # graph scale: keep the edge list sparse end to end
+        link = build_transition_operator(edges, mesh=mesh)
+    else:
+        link = mt.BlockMatrix.from_array(build_transition_matrix(edges), mesh)
 
     t0 = millis()
     ranks = pagerank(link, iterations=iterations)

@@ -48,9 +48,7 @@ def save_sharded(arr: jax.Array, path: str) -> None:
         json.dump(manifest, f)
 
 
-def load_sharded(path: str, sharding=None) -> jax.Array:
-    """Assemble the global array from shard files; re-place onto ``sharding``
-    (or leave on the default device)."""
+def _read_manifests(path: str):
     manifests = [
         json.load(open(os.path.join(path, f)))
         for f in sorted(os.listdir(path))
@@ -60,17 +58,64 @@ def load_sharded(path: str, sharding=None) -> jax.Array:
         raise FileNotFoundError(f"no checkpoint manifests under {path}")
     shape = tuple(manifests[0]["shape"])
     dtype = np.dtype(manifests[0]["dtype"])
-    out = np.zeros(shape, dtype)
+    # replica-0 shards only, deduped by index (replicated shardings store the
+    # same region once per owning process)
+    files = {}
     for man in manifests:
         for sh in man["shards"]:
             if sh["replica_id"] != 0:
                 continue
-            idx = tuple(slice(a if a is not None else 0, b) for a, b in sh["index"])
-            out[idx] = np.load(os.path.join(path, sh["file"]))
-    arr = jax.numpy.asarray(out)
+            key = tuple(
+                (a if a is not None else 0, b if b is not None else d)
+                for (a, b), d in zip(sh["index"], shape)
+            )
+            files.setdefault(key, sh["file"])
+    return shape, dtype, files
+
+
+def _read_region(path, files, region, shape, dtype):
+    """Materialize one target-shard region by slicing only the saved shard
+    files that overlap it (memory-mapped, so a file contributes just the
+    overlapping rows — never the whole global array)."""
+    bounds = tuple(s.indices(d) for s, d in zip(region, shape))
+    out = np.empty(tuple(b[1] - b[0] for b in bounds), dtype)
+    covered = 0
+    for key, fname in files.items():
+        overlap = tuple(
+            (max(a, lo), min(b, hi)) for (a, b), (lo, hi, _) in zip(key, bounds)
+        )
+        if any(a >= b for a, b in overlap):
+            continue
+        data = np.load(os.path.join(path, fname), mmap_mode="r")
+        src = tuple(slice(a - ka, b - ka) for (a, b), (ka, _) in zip(overlap, key))
+        dst = tuple(slice(a - lo, b - lo) for (a, b), (lo, _, _) in zip(overlap, bounds))
+        out[dst] = data[src]
+        covered += int(np.prod([b - a for a, b in overlap]))
+    if covered != out.size:
+        raise ValueError(
+            f"checkpoint at {path} does not cover region {bounds}: "
+            f"{covered}/{out.size} elements present (missing manifests from "
+            "other hosts?)"
+        )
+    return out
+
+
+def load_sharded(path: str, sharding=None) -> jax.Array:
+    """Restore a sharded-array checkpoint. With ``sharding``, each target shard
+    is read straight from the overlapping shard files and placed on its own
+    device — the global array is never assembled on the host, so arrays that
+    were sharded *because* they don't fit one host restore fine, and each
+    process of a multi-host job touches only its addressable shards. Without
+    ``sharding``, the array is assembled host-side (single-device convenience).
+    """
+    shape, dtype, files = _read_manifests(path)
     if sharding is not None:
-        arr = jax.device_put(arr, sharding)
-    return arr
+        return jax.make_array_from_callback(
+            shape, sharding,
+            lambda region: _read_region(path, files, region, shape, dtype),
+        )
+    full = (slice(0, d) for d in shape)
+    return jax.numpy.asarray(_read_region(path, files, tuple(full), shape, dtype))
 
 
 def save_checkpoint(state, path: str, step: int) -> None:

@@ -99,13 +99,19 @@ class CoordinateMatrix:
 
     def save_to_file_system(self, path: str):
         """Write ``i j v`` COO text — the same format load_coordinate_matrix
-        parses (the reference ships a loader but no writer)."""
+        parses (the reference ships a loader but no writer). Routed through the
+        native writer (textio.cpp mt_save_coo: 10⁸ nnz in seconds) with a
+        pure-Python fallback when the shared object isn't built."""
         import os
+
+        from .. import native
 
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         ri = np.asarray(self.row_indices)
         ci = np.asarray(self.col_indices)
         vals = np.asarray(self.values)
+        if native.save_coo_text(path, ri, ci, vals):
+            return
         with open(path, "w") as f:
             for i, j, v in zip(ri, ci, vals):
                 f.write(f"{int(i)} {int(j)} {float(v)!r}\n")

@@ -14,8 +14,12 @@
 //   mt_count_matrix(path, *rows, *cols)   — scan pass: dimensions
 //   mt_load_matrix(path, out, rows, cols) — parse pass: fill row-major f64
 //   mt_save_matrix(path, data, rows, cols)— write the same format
+//   mt_save_coo(path, rows, cols, vals, nnz) — "i j v" COO lines
+//     (CoordinateMatrix text format, matrix/CoordinateMatrix.scala entries;
+//      std::to_chars shortest round-trip, matching Python repr() precision)
 
 #include <cerrno>
+#include <charconv>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -156,6 +160,70 @@ int mt_save_matrix(const char* path, const double* data, int64_t rows, int64_t c
   }
   std::fclose(f);
   return 0;
+}
+
+}  // extern "C"
+
+namespace {
+
+// Format into a big user-space buffer with to_chars (shortest round-trip,
+// like Python repr) and flush in MB-scale fwrites — 10^8 nnz in ~20 s where
+// the per-line Python writer took minutes (matrix/sparse.py). The f32
+// overload is ~5x faster per value AND exact for f32-originated data (the
+// CoordinateMatrix value type, matching the reference's Float entries,
+// matrix/CoordinateMatrix.scala:14) — shortest-repr of the f64 image of an
+// f32 would pay up to 17 digits for nothing.
+template <typename V>
+int save_coo_impl(const char* path, const int64_t* rows, const int64_t* cols,
+                  const V* vals, int64_t nnz) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -errno;
+  constexpr size_t kCap = size_t{1} << 22;
+  constexpr size_t kMaxLine = 96;  // 2 int64s + value + separators, worst case
+  char* buf = static_cast<char*>(std::malloc(kCap));
+  if (!buf) {
+    std::fclose(f);
+    return -ENOMEM;
+  }
+  size_t used = 0;
+  for (int64_t k = 0; k < nnz; ++k) {
+    if (used + kMaxLine > kCap) {
+      if (std::fwrite(buf, 1, used, f) != used) {
+        std::free(buf);
+        std::fclose(f);
+        return -EIO;
+      }
+      used = 0;
+    }
+    char* p = buf + used;
+    char* cap = buf + kCap;
+    p = std::to_chars(p, cap, static_cast<long long>(rows[k])).ptr;
+    *p++ = ' ';
+    p = std::to_chars(p, cap, static_cast<long long>(cols[k])).ptr;
+    *p++ = ' ';
+    p = std::to_chars(p, cap, vals[k]).ptr;
+    *p++ = '\n';
+    used = p - buf;
+  }
+  int rc = 0;
+  if (used && std::fwrite(buf, 1, used, f) != used) rc = -EIO;
+  std::free(buf);
+  if (std::fclose(f) != 0 && rc == 0) rc = -errno;
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+int mt_save_coo(const char* path, const int64_t* rows, const int64_t* cols,
+                const double* vals, int64_t nnz) {
+  return save_coo_impl(path, rows, cols, vals, nnz);
+}
+
+int mt_save_coo_f32(const char* path, const int64_t* rows, const int64_t* cols,
+                    const float* vals, int64_t nnz) {
+  return save_coo_impl(path, rows, cols, vals, nnz);
 }
 
 }  // extern "C"

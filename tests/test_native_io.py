@@ -93,3 +93,36 @@ def test_native_colonless_line_raises(tmp_path, lib_ok):
     with open(p2, "w") as f:
         f.write("0:1.0,2.0\n\n1:3.0,4.0\n")
     np.testing.assert_allclose(native.load_matrix_text(p2), [[1, 2], [3, 4]])
+
+
+def test_native_coo_roundtrip(tmp_path, mesh, lib_ok):
+    import marlin_tpu as mt
+
+    rng = np.random.default_rng(2)
+    nnz = 5000
+    ri = rng.integers(0, 400, nnz)
+    ci = rng.integers(0, 300, nnz)
+    vals = rng.standard_normal(nnz)
+    # exercise extremes of the shortest-repr formatter (within f32 range —
+    # the loader narrows to f32, matching the reference's Float entries)
+    vals[:4] = [0.0, 1e-30, -1e30, 0.1]
+    p = str(tmp_path / "coo.txt")
+    assert native.save_coo_text(p, ri, ci, vals)
+    coo = mt.load_coordinate_matrix(p, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(coo.row_indices), ri)
+    np.testing.assert_array_equal(np.asarray(coo.col_indices), ci)
+    np.testing.assert_allclose(np.asarray(coo.values, np.float64), vals,
+                               rtol=1e-7)  # loader may narrow to f32
+
+
+def test_coordinate_matrix_save_uses_native(tmp_path, mesh, lib_ok):
+    import marlin_tpu as mt
+
+    coo = mt.CoordinateMatrix([0, 1, 7], [2, 0, 5], [1.5, -2.25, 3.0],
+                              shape=(8, 6), mesh=mesh)
+    p = str(tmp_path / "saved.txt")
+    coo.save_to_file_system(p)
+    text = open(p).read()
+    assert text == "0 2 1.5\n1 0 -2.25\n7 5 3\n"
+    back = mt.load_coordinate_matrix(p, shape=(8, 6), mesh=mesh)
+    np.testing.assert_allclose(np.asarray(back.values), [1.5, -2.25, 3.0])

@@ -1,0 +1,152 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The XLA formulation in :mod:`marlin_tpu.parallel.ring_attention` materializes
+each (sq × kv_tile) score tile in HBM between the two matmuls and the softmax
+update — at 32k tokens that is hundreds of MB of HBM traffic per tile, and the
+measured ceiling is a few TFLOP/s. This kernel is the classic flash-attention
+schedule on the MXU: score tiles live only in VMEM, the running max/denominator
+(m, l) and the f32 output accumulator update in VMEM scratch across KV blocks,
+and fully-masked causal blocks are predicated off with ``pl.when`` so the
+causal pass does half the matmul work.
+
+The kernel is shaped as a *panel* update so ring attention can drive it: it
+takes the carried (m, l, acc) state in and returns the updated state, with
+global query/key offsets and a valid-length bound supplied as scalar-prefetch
+arguments (the ring rotates K/V panels, so the key offset changes per step).
+Single-device attention is the one-panel special case.
+
+No reference analog (the reference predates attention, SURVEY.md §2.7);
+this is the long-context mandate's hot kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention_panel"]
+
+_NEG = -1e30
+
+
+def _panel_kernel(s_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
+                  m_out, l_out, acc_out, m_s, l_s, acc_s,
+                  *, causal: bool, scale: float, bq: int, bkv: int):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _load_carry():
+        m_s[:] = m_in[:]
+        l_s[:] = l_in[:]
+        acc_s[:] = acc_in[:]
+
+    q_start = s_ref[0] + pl.program_id(0) * bq
+    k_start = s_ref[1] + j * bkv
+    valid = s_ref[2]
+    live = k_start < valid
+    if causal:
+        # block is fully masked when even the last query row precedes the
+        # first key of the block — skip the matmuls entirely
+        live = jnp.logical_and(live, q_start + bq - 1 >= k_start)
+
+    @pl.when(live)
+    def _accumulate():
+        s = jax.lax.dot_general(
+            q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+        keep = kpos < valid
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            keep = jnp.logical_and(keep, qpos >= kpos)
+        s = jnp.where(keep, s, _NEG)
+        m_prev = m_s[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # exp(s - m_new) alone mis-handles a fully-masked row whose running
+        # max is still _NEG (exp(0) = 1 per masked key); zero them exactly
+        p = jnp.where(keep, jnp.exp(s - m_new), 0.0)
+        l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + jnp.dot(
+            p.astype(v_ref.dtype), v_ref[:], preferred_element_type=jnp.float32
+        )
+        m_s[:] = m_new
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _flush():
+        m_out[:] = m_s[:]
+        l_out[:] = l_s[:]
+        acc_out[:] = acc_s[:]
+
+
+def flash_attention_panel(q, k, v, m, l, acc, q_offset, k_offset, valid_len,
+                          *, causal: bool, scale: float, bq: int = 1024,
+                          bkv: int = 1024, interpret: bool | None = None):
+    """One flash pass of queries ``q`` (sq, d) against a K/V panel (skv, d),
+    updating the running state:
+
+    - ``m``/``l``: (sq, 1) f32 running max / softmax denominator
+    - ``acc``: (sq, d) f32 unnormalized output accumulator
+    - ``q_offset``/``k_offset``: global positions of q row 0 / panel key 0
+      (the ring caller's device coordinate × block size)
+    - ``valid_len``: global sequence length; keys at/after it are masked
+
+    Returns the updated ``(m, l, acc)``. The caller divides ``acc / l`` after
+    the last panel. Block sizes are clamped to the panel dims; sq and skv must
+    then divide by them (the ring caller pads to guarantee it).
+    """
+    sq, d = q.shape
+    skv = k.shape[0]
+    bq = min(bq, sq)
+    bkv = min(bkv, skv)
+    if sq % bq or skv % bkv:
+        raise ValueError(f"block sizes ({bq},{bkv}) must divide panel dims "
+                         f"({sq},{skv})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scalars = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32),
+                         jnp.asarray(valid_len, jnp.int32)])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(sq // bq, skv // bkv),
+        in_specs=[
+            pl.BlockSpec((bq, d), lambda i, j, *_: (i, 0)),
+            pl.BlockSpec((bkv, d), lambda i, j, *_: (j, 0)),
+            pl.BlockSpec((bkv, d), lambda i, j, *_: (j, 0)),
+            pl.BlockSpec((bq, 1), lambda i, j, *_: (i, 0)),
+            pl.BlockSpec((bq, 1), lambda i, j, *_: (i, 0)),
+            pl.BlockSpec((bq, d), lambda i, j, *_: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bq, 1), lambda i, j, *_: (i, 0)),
+            pl.BlockSpec((bq, 1), lambda i, j, *_: (i, 0)),
+            pl.BlockSpec((bq, d), lambda i, j, *_: (i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )
+    kern = functools.partial(_panel_kernel, causal=causal, scale=scale,
+                             bq=bq, bkv=bkv)
+    # under shard_map the inputs carry varying-manual-axes types; the outputs
+    # must declare the same so the vma checker can see through pallas_call
+    vma = getattr(jax.typeof(q), "vma", frozenset())
+    m2, l2, a2 = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((sq, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((sq, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((sq, d), jnp.float32, vma=vma),
+        ],
+        interpret=interpret,
+    )(scalars, q, k, v, m, l, acc)
+    return m2, l2, a2

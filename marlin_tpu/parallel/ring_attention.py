@@ -50,16 +50,52 @@ def attention_reference(q, k, v, causal: bool = False, scale: float | None = Non
 _KV_TILE = 2048  # inner tile bounding the (sq × tile) score buffer
 
 
+def _block_divisor(n: int, cap: int = 1024) -> int:
+    """Largest power-of-two ≤ cap dividing n (flash block size picker)."""
+    b = 1
+    while b < cap and n % (b * 2) == 0:
+        b *= 2
+    return b
+
+
 @functools.lru_cache(maxsize=32)
-def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float):
+def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
+                  flash: bool):
     """One kernel covers all cases: ``valid_len`` masks padded key positions
     (a no-op when the sequence fills the padded length), and ``causal`` adds
-    the triangular mask on top. Within each ring step the resident K/V panel
-    is processed in fixed KV tiles, so per-device score memory is
+    the triangular mask on top. With ``flash`` the per-panel inner loop is the
+    Pallas flash kernel (ops/flash_attention.py — score tiles never leave
+    VMEM); otherwise, within each ring step the resident K/V panel is
+    processed in fixed KV tiles, so per-device score memory is
     O(seq/p · tile) instead of O((seq/p)²) — long sequences on small rings
     (including ring size 1) stay in HBM."""
     p_size = mesh.shape[axis]
     perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+    def local_flash(q_blk, k_blk, v_blk, valid_len):
+        from ..ops.flash_attention import flash_attention_panel
+
+        sq, d = q_blk.shape
+        skv = k_blk.shape[0]
+        b = _block_divisor(min(sq, skv))
+        idx = jax.lax.axis_index(axis)
+
+        m = jnp.full((sq, 1), _NEG, jnp.float32)
+        l = jnp.zeros((sq, 1), jnp.float32)
+        acc = jnp.zeros((sq, d), jnp.float32)
+        k_cur, v_cur = k_blk, v_blk
+        # ring steps unrolled: p_size is static and small, and a fori_loop
+        # carrying a pallas_call trips a lowering-cache bug under shard_map
+        for i in range(p_size):
+            owner = (idx - i) % p_size
+            k_next = jax.lax.ppermute(k_cur, axis, perm)
+            v_next = jax.lax.ppermute(v_cur, axis, perm)
+            m, l, acc = flash_attention_panel(
+                q_blk, k_cur, v_cur, m, l, acc,
+                idx * sq, owner * skv, valid_len,
+                causal=causal, scale=scale, bq=b, bkv=b)
+            k_cur, v_cur = k_next, v_next
+        return (acc / jnp.maximum(l, 1e-30)).astype(q_blk.dtype)
 
     def local(q_blk, k_blk, v_blk, valid_len):
         # q_blk: (sq, d) stationary; k_blk/v_blk: (skv, d) rotating
@@ -114,11 +150,15 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float):
 
     @jax.jit
     def f(q, k, v, valid_len):
+        # check_vma off on the flash path: the pallas interpreter's block
+        # slicing mixes varying and invariant operands, which the vma checker
+        # rejects (the XLA path keeps full checking)
         return jax.shard_map(
-            local,
+            local_flash if flash else local,
             mesh=mesh,
             in_specs=(P(axis, None), P(axis, None), P(axis, None), P()),
             out_specs=P(axis, None),
+            check_vma=not flash,
         )(q, k, v, valid_len)
 
     return f
@@ -132,35 +172,53 @@ def ring_attention(
     axis: str = ROWS,
     causal: bool = False,
     scale: float | None = None,
+    backend: str = "auto",
 ) -> jax.Array:
     """Exact attention with the sequence sharded over ``axis``.
 
     ``q``/``k``/``v``: (seq, d), or (heads, seq, d) for multi-head (vmapped
     over heads). Sequence lengths are padded to the ring size; padded key
-    positions are masked out of the softmax exactly."""
-    if q.ndim == 3:
-        fn = jax.vmap(lambda qh, kh, vh: ring_attention(
-            qh, kh, vh, mesh, axis, causal, scale))
-        return fn(q, k, v)
-    seq, d = q.shape
-    if k.shape != (seq, d) or v.shape != (seq, d):
+    positions are masked out of the softmax exactly.
+
+    ``backend``: ``"flash"`` runs each panel through the Pallas flash kernel
+    (score tiles stay in VMEM, causal blocks below the diagonal skipped);
+    ``"xla"`` keeps the tiled XLA formulation; ``"auto"`` picks flash on TPU
+    for MXU-friendly head dims and XLA elsewhere."""
+    if q.ndim not in (2, 3) or k.shape != q.shape or v.shape != q.shape:
         raise ValueError(f"q/k/v shape mismatch: {q.shape} {k.shape} {v.shape}")
+    if backend not in ("auto", "flash", "xla"):
+        raise ValueError(f"unknown ring attention backend: {backend!r}")
+    seq, d = q.shape[-2], q.shape[-1]
     mesh = mesh or default_mesh()
     p_size = mesh.shape[axis]
+    flash = backend == "flash" or (
+        backend == "auto" and jax.default_backend() == "tpu" and d % 128 == 0
+    )
     sp = pad_to_multiple(seq, p_size)
     if sp // p_size > _KV_TILE:
         # pad so each device's panel is a whole number of KV tiles — the
         # memory bound (sq × _KV_TILE scores) must hold for ANY length, and
         # valid_len masks the padded keys exactly
         sp = p_size * pad_to_multiple(sp // p_size, _KV_TILE)
+    if flash:
+        # flash blocks are power-of-two divisors of the panel length; pad the
+        # panel to a 128 multiple so _block_divisor never degenerates below
+        # the (8, 128) f32 tile Mosaic wants (a 1-wide block grid would be a
+        # compile failure or a perf cliff)
+        sp = p_size * pad_to_multiple(sp // p_size, 128)
+    pad = ((0, 0),) * (q.ndim - 2) + ((0, sp - seq), (0, 0))
     if sp != seq:
-        q = jnp.pad(q, ((0, sp - seq), (0, 0)))
-        k = jnp.pad(k, ((0, sp - seq), (0, 0)))
-        v = jnp.pad(v, ((0, sp - seq), (0, 0)))
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
     scale_val = float(scale if scale is not None else 1.0 / math.sqrt(d))
-    sh = NamedSharding(mesh, P(axis, None))
+    # sharding is placed on the SEQUENCE axis here, before any head vmap —
+    # sharding inside the vmapped function would partition the heads axis
+    spec = P(axis, None) if q.ndim == 2 else P(None, axis, None)
+    sh = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
-    out = _ring_attn_fn(mesh, axis, causal, scale_val)(
-        q, k, v, jnp.asarray(seq, jnp.int32)
-    )
-    return out[:seq] if sp != seq else out
+    f = _ring_attn_fn(mesh, axis, causal, scale_val, flash)
+    vl = jnp.asarray(seq, jnp.int32)
+    if q.ndim == 3:
+        out = jax.vmap(lambda qh, kh, vh: f(qh, kh, vh, vl))(q, k, v)
+    else:
+        out = f(q, k, v, vl)
+    return out[..., :seq, :] if sp != seq else out

@@ -34,30 +34,60 @@ class NonFiniteLossError(RuntimeError):
     same remedy as a device loss."""
 
 
-def heartbeat(timeout_s: float = 30.0) -> dict:
+def heartbeat(timeout_s: float = 30.0, raise_on_failure: bool = True) -> dict:
     """Probe every visible device with a tiny computation; returns
-    {device_str: latency_s}. The probe runs in a watchdog thread so a truly
-    hung device surfaces as a TimeoutError instead of hanging the caller —
-    ``block_until_ready`` alone would block forever on a wedged device."""
+    {device_str: latency_s}, with ``float('inf')`` marking devices that
+    missed the deadline or raised (a dead device usually *errors* from the
+    runtime rather than hanging — those exceptions ride on the returned
+    mapping as ``.errors``). All probes launch concurrently and every device
+    is waited on against one shared deadline, so a single wedged device
+    neither serializes the sweep nor hides the status of the devices behind
+    it. With ``raise_on_failure`` a TimeoutError naming *all* failed devices
+    (and their errors) is raised after the full sweep; the per-device map
+    rides on the exception as ``.results``. A truly hung
+    ``block_until_ready`` thread cannot be killed from Python; it is left as
+    a daemon and never re-joined, so a stuck probe cannot wedge later
+    heartbeats."""
     import threading
 
-    out = {}
-    for dev in jax.devices():
-        result: dict = {}
+    results: dict[str, float] = {}
+    errors: dict[str, Exception] = {}
+    lock = threading.Lock()
+    t0 = time.perf_counter()
 
-        def probe(d=dev, r=result):
+    def probe(d):
+        try:
             x = jax.device_put(jnp.ones(()), d)
             jax.block_until_ready(x + 1.0)
-            r["ok"] = True
+            with lock:
+                results[str(d)] = time.perf_counter() - t0
+        except Exception as e:  # a dead device ERRORS rather than hangs —
+            with lock:          # record it instead of mislabeling as timeout
+                errors[str(d)] = e
 
-        t0 = time.perf_counter()
-        th = threading.Thread(target=probe, daemon=True)
+    threads = [threading.Thread(target=probe, args=(d,), daemon=True)
+               for d in jax.devices()]
+    for th in threads:
         th.start()
-        th.join(timeout_s)
-        dt = time.perf_counter() - t0
-        if th.is_alive() or "ok" not in result:
-            raise TimeoutError(f"device {dev} heartbeat timed out after {dt:.1f}s")
-        out[str(dev)] = dt
+    deadline = t0 + timeout_s
+    for th in threads:
+        th.join(max(0.0, deadline - time.perf_counter()))
+
+    class _Results(dict):
+        pass
+
+    out = _Results({str(d): results.get(str(d), float("inf"))
+                    for d in jax.devices()})
+    out.errors = dict(errors)
+    failed = sorted(k for k, v in out.items() if v == float("inf"))
+    if failed and raise_on_failure:
+        detail = "; ".join(f"{k}: {errors[k]!r}" for k in failed if k in errors)
+        err = TimeoutError(
+            f"{len(failed)}/{len(out)} device heartbeats failed after "
+            f"{timeout_s:.1f}s: {', '.join(failed)}"
+            + (f" (device errors: {detail})" if detail else ""))
+        err.results = out
+        raise err
     return out
 
 

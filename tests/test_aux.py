@@ -54,7 +54,59 @@ def test_heartbeat():
 
     beats = heartbeat()
     assert len(beats) == len(jax.devices())
-    assert all(v >= 0 for v in beats.values())
+    assert all(0 <= v < float("inf") for v in beats.values())
+
+
+def test_heartbeat_reports_all_devices_on_timeout(monkeypatch):
+    # a wedged device must not hide the others' status or hang the sweep
+    import jax
+
+    import marlin_tpu.utils.failure as failure
+
+    real_block = jax.block_until_ready
+    wedged = jax.devices()[1]
+
+    def fake_block(x):
+        if x.devices() == {wedged}:
+            import time
+            time.sleep(60)
+        return real_block(x)
+
+    monkeypatch.setattr(failure.jax, "block_until_ready", fake_block)
+    with pytest.raises(TimeoutError) as ei:
+        heartbeat(timeout_s=3.0)
+    res = ei.value.results
+    assert res[str(wedged)] == float("inf")
+    healthy = [v for k, v in res.items() if k != str(wedged)]
+    assert len(healthy) == len(jax.devices()) - 1
+    assert all(v < float("inf") for v in healthy)
+    # non-raising form returns the same map
+    monkeypatch.setattr(failure.jax, "block_until_ready", real_block)
+    ok = heartbeat(timeout_s=30.0, raise_on_failure=False)
+    assert all(v < float("inf") for v in ok.values())
+
+
+def test_heartbeat_records_device_errors(monkeypatch):
+    # a dead device typically ERRORS immediately; the exception must surface,
+    # not be mislabeled as a 30s timeout
+    import jax
+
+    import marlin_tpu.utils.failure as failure
+
+    real_put = jax.device_put
+    dead = jax.devices()[2]
+
+    def fake_put(x, d=None):
+        if d == dead:
+            raise RuntimeError("chip lost")
+        return real_put(x, d)
+
+    monkeypatch.setattr(failure.jax, "device_put", fake_put)
+    res = heartbeat(timeout_s=10.0, raise_on_failure=False)
+    assert res[str(dead)] == float("inf")
+    assert "chip lost" in str(res.errors[str(dead)])
+    with pytest.raises(TimeoutError, match="chip lost"):
+        heartbeat(timeout_s=10.0)
 
 
 def test_event_log(tmp_path):

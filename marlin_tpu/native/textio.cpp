@@ -27,12 +27,32 @@
 
 namespace {
 
-// Fast float parse: strtod is locale-burdened but correct; for bulk numeric
-// text it is still ~10x faster than Python's float() round-trip. Keep it.
 inline const char* skip_seps(const char* p, const char* end) {
   // the reference's separator rule: ",\s?|\s+"
   while (p < end && (*p == ',' || *p == ' ' || *p == '\t' || *p == '\r')) ++p;
   return p;
+}
+
+// Fast float parse: std::from_chars (Eisel-Lemire) is correctly rounded,
+// locale-free, bounded by `end` (no null-termination scan), and ~4x faster
+// than strtod. strtod's extras (hex floats, leading '+') don't occur in this
+// format except '+' signs, which we skip ourselves for parity with the
+// Python parser's float().
+inline const char* parse_value(const char* q, const char* end, double* out) {
+  if (q < end && *q == '+') ++q;
+  auto r = std::from_chars(q, end, *out);
+  if (r.ec == std::errc()) return r.ptr;
+  if (r.ec == std::errc::result_out_of_range) {
+    // '1e400' / '1e-400': keep strtod's ±HUGE_VAL / ±0 semantics (what
+    // Python's float() does too) rather than rejecting the file; the token
+    // ends before `end` and the file buffer is NUL-terminated, so strtod
+    // cannot scan out of bounds. Rare, so the slow path costs nothing.
+    char* next = nullptr;
+    *out = std::strtod(q, &next);
+    if (next == q || next > end) return nullptr;
+    return next;
+  }
+  return nullptr;
 }
 
 struct FileBuf {
@@ -92,9 +112,9 @@ int mt_count_matrix(const char* path, int64_t* rows, int64_t* cols) {
         while (q < line_end) {
           q = skip_seps(q, line_end);
           if (q >= line_end) break;
-          char* next = nullptr;
-          std::strtod(q, &next);
-          if (next == q) return -EINVAL;
+          double v;
+          const char* next = parse_value(q, line_end, &v);
+          if (!next) return -EINVAL;
           ++line_cols;
           q = next;
         }
@@ -132,9 +152,9 @@ int mt_load_matrix(const char* path, double* out, int64_t rows, int64_t cols) {
         while (q < line_end && j < cols) {
           q = skip_seps(q, line_end);
           if (q >= line_end) break;
-          char* next = nullptr;
-          double v = std::strtod(q, &next);
-          if (next == q) return -EINVAL;  // corrupt token: fail, don't zero-fill
+          double v;
+          const char* next = parse_value(q, line_end, &v);
+          if (!next) return -EINVAL;  // corrupt token: fail, don't zero-fill
           row_out[j++] = v;
           q = next;
         }

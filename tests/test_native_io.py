@@ -126,3 +126,14 @@ def test_coordinate_matrix_save_uses_native(tmp_path, mesh, lib_ok):
     assert text == "0 2 1.5\n1 0 -2.25\n7 5 3\n"
     back = mt.load_coordinate_matrix(p, shape=(8, 6), mesh=mesh)
     np.testing.assert_allclose(np.asarray(back.values), [1.5, -2.25, 3.0])
+
+
+def test_native_out_of_range_tokens(tmp_path, lib_ok):
+    # float('1e400') -> inf in Python; the native parser must agree, not
+    # reject the file (from_chars result_out_of_range fallback)
+    p = str(tmp_path / "big.txt")
+    with open(p, "w") as f:
+        f.write("0:1e400,-1e400,1e-400,2.5\n")
+    nat = native.load_matrix_text(p)
+    assert nat[0, 0] == np.inf and nat[0, 1] == -np.inf
+    assert nat[0, 2] == 0.0 and nat[0, 3] == 2.5

@@ -47,6 +47,7 @@ from .io import (  # noqa: F401
     save_matrix,
 )
 from .utils import evaluate, timer  # noqa: F401
+from .lazy import fuse  # noqa: F401
 from . import random  # noqa: F401
 
 __version__ = "0.1.0"

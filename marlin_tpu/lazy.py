@@ -1,0 +1,46 @@
+"""Fused (lazy) evaluation of matrix expressions.
+
+The reference defers work by construction: every matrix op builds RDD lineage
+and nothing runs until a Spark action forces the DAG (SURVEY.md §3.1 — "pure
+DAG construction on the driver"). The TPU-native equivalent is tracing: all
+matrix types are registered as pytrees (matrix/dense.py), so a function over
+matrices can be handed to ``jax.jit`` and every chained method call — multiply,
+add, scale, transpose, sum — fuses into ONE compiled XLA program with one
+dispatch. This kills per-op dispatch overhead on chained expressions (the
+eager path pays one dispatch per op — ROADMAP.md perf note) and lets XLA fuse
+elementwise work into the matmuls it neighbors.
+
+:func:`fuse` is the documented alias with the matrix-level contract spelled
+out; it also works as a decorator factory (``@fuse`` or ``@fuse(donate=...)``).
+
+Because tracing is compilation, the usual jit rules apply inside a fused
+function: shapes/meshes/specs are static (a new operand geometry recompiles),
+and host-side terminal ops (``to_numpy``, ``float(...)``, ``save``) belong
+outside. Autodiff composes: ``jax.grad`` of a fused scalar loss over matrices
+returns matrix-typed cotangents — a capability with no reference analog.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+__all__ = ["fuse"]
+
+
+def fuse(fn=None, **jit_kwargs):
+    """``jax.jit`` for matrix-level functions: one compiled dispatch for the
+    whole expression chain.
+
+    >>> @fuse
+    ... def step(a, b, c):
+    ...     return a.multiply(b).add(c).multiply(2.0)
+    >>> out = step(a, b, c)   # one dispatch, XLA-fused
+
+    Accepts the same keyword arguments as ``jax.jit`` (``donate_argnums``,
+    ``static_argnames``, ...).
+    """
+    if fn is None:
+        return functools.partial(fuse, **jit_kwargs)
+    return jax.jit(fn, **jit_kwargs)

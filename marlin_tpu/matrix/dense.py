@@ -86,7 +86,14 @@ class DenseMatrix(DistributedMatrix):
         if (mp, np_) != (m, n):
             arr = jnp.pad(arr, ((0, mp - m), (0, np_ - n)))
         sharding = NamedSharding(mesh, spec)
-        if not (isinstance(arr, jax.Array) and arr.sharding == sharding):
+        # tracers have no .sharding — under jit, device_put is a sharding
+        # constraint XLA folds away, so just always apply it there
+        placed = (
+            isinstance(arr, jax.Array)
+            and not isinstance(arr, jax.core.Tracer)
+            and arr.sharding == sharding
+        )
+        if not placed:
             arr = jax.device_put(arr, sharding)
         return cls(arr, (m, n), mesh, spec)
 
@@ -605,3 +612,22 @@ def _power_iteration_norm2(a):
     return jnp.linalg.norm(jnp.dot(a, v, precision="highest"))
 
 
+
+
+# --------------------------------------------------------------------- pytree
+# Matrices flatten to (data,) with the static identity (shape, mesh, spec) as
+# hashable aux data, so the whole matrix API is jit/grad/vmap-traceable:
+# ``jax.jit`` of a function over matrices fuses every chained method call into
+# ONE compiled dispatch (the lazy-evaluation answer to the reference's RDD DAG
+# deferral — Spark builds a lineage graph and runs it on an action; here XLA
+# traces the chain and fuses it). ``marlin_tpu.fuse`` is the documented alias.
+def _register_matrix_pytree(cls):
+    jax.tree_util.register_pytree_node(
+        cls,
+        lambda m: ((m.data,), (m._shape, m.mesh, m.spec)),
+        lambda aux, ch: cls(ch[0], aux[0], aux[1], aux[2]),
+    )
+
+
+for _cls in (DenseMatrix, DenseVecMatrix, BlockMatrix):
+    _register_matrix_pytree(_cls)

@@ -178,3 +178,13 @@ class DistributedIntVector(DistributedVector):
     @classmethod
     def from_array(cls, arr, mesh=None, column_major=True, dtype=None):
         return super().from_array(arr, mesh, column_major, dtype=dtype or jnp.int32)
+
+
+# the pytree registry is exact-type keyed — register every subclass so int
+# vectors are jit/fuse-traceable too (see matrix/dense.py pytree note)
+for _cls in (DistributedVector, DistributedIntVector):
+    jax.tree_util.register_pytree_node(
+        _cls,
+        lambda v: ((v.data,), (v._length, v.mesh, v.column_major)),
+        (lambda c: lambda aux, ch: c(ch[0], aux[0], aux[1], aux[2]))(_cls),
+    )

@@ -1,0 +1,111 @@
+"""Fused (lazy) matrix expressions: pytree-registered matrix types traced
+through jax.jit — the RDD-lineage-deferral analog (SURVEY.md §3.1)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import marlin_tpu as mt
+
+
+@pytest.fixture()
+def abc(mesh):
+    a = mt.DenseVecMatrix.random(0, 100, 60, mesh=mesh)
+    b = mt.DenseVecMatrix.random(1, 60, 80, mesh=mesh)
+    c = mt.DenseVecMatrix.random(2, 100, 80, mesh=mesh)
+    return a, b, c
+
+
+def test_fuse_chain_matches_oracle(abc):
+    a, b, c = abc
+
+    @mt.fuse
+    def chain(a, b, c):
+        return a.multiply(b).add(c).multiply(2.0).transpose()
+
+    out = chain(a, b, c)
+    ref = 2.0 * (a.to_numpy() @ b.to_numpy() + c.to_numpy()).T
+    np.testing.assert_allclose(out.to_numpy(), ref, rtol=1e-4, atol=1e-4)
+    assert out.shape == (80, 100)
+
+
+def test_fuse_single_trace(abc):
+    a, b, c = abc
+    traces = []
+
+    @mt.fuse
+    def chain(a, b, c):
+        traces.append(1)
+        return a.multiply(b).add(c)
+
+    r1 = chain(a, b, c)
+    r2 = chain(a, b, c)  # cache hit: no retrace, no python dispatch chain
+    assert len(traces) == 1
+    np.testing.assert_allclose(r1.to_numpy(), r2.to_numpy())
+
+
+def test_fuse_grad_returns_matrix_cotangent(abc):
+    a, b, _ = abc
+
+    @mt.fuse
+    def loss(a, b):
+        return a.multiply(b).sum()
+
+    g = jax.grad(lambda a: loss(a, b))(a)
+    assert isinstance(g, mt.DenseVecMatrix)
+    assert g.shape == a.shape
+    # d(sum(AB))/dA = 1 @ B^T
+    ref = np.ones((100, 80), np.float32) @ b.to_numpy().T
+    np.testing.assert_allclose(g.to_numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fuse_vector_roundtrip(mesh):
+    v = mt.DistributedVector.from_array(np.arange(10, dtype=np.float32), mesh)
+    a = mt.DenseVecMatrix.random(3, 7, 10, mesh=mesh)
+
+    @mt.fuse
+    def mv(a, v):
+        return a.multiply_vector(v)
+
+    out = mv(a, v)
+    assert isinstance(out, mt.DistributedVector)
+    np.testing.assert_allclose(out.to_numpy(), a.to_numpy() @ v.to_numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fuse_shape_mismatch_raises_at_trace(abc):
+    a, b, _ = abc
+
+    @mt.fuse
+    def bad(a, b):
+        return b.multiply(a)  # (60,80) @ (100,60): inner-dim mismatch
+
+    with pytest.raises(ValueError, match="inner dim"):
+        bad(a, b)
+
+
+def test_fuse_block_matrix(mesh, a4):
+    am = mt.BlockMatrix.from_array(a4, mesh)
+    bm = mt.BlockMatrix.from_array(a4.T, mesh)
+
+    @mt.fuse
+    def f(x, y):
+        return x.multiply(y).subtract(x)
+
+    out = f(am, bm)
+    np.testing.assert_allclose(out.to_numpy(), a4 @ a4.T - a4,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fuse_int_vector(mesh):
+    iv = mt.DistributedIntVector.from_array(np.arange(6), mesh)
+
+    @mt.fuse
+    def double(v):
+        return type(v)(v.data * 2, v._length, v.mesh, v.column_major)
+
+    out = double(iv)
+    assert isinstance(out, mt.DistributedIntVector)
+    np.testing.assert_array_equal(out.to_numpy(), np.arange(6) * 2)

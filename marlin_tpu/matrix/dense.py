@@ -237,7 +237,11 @@ class DenseMatrix(DistributedMatrix):
     element_multiply = dot_product  # BlockMatrix.elementMultiply (BlockMatrix.scala:673-680)
 
     def sum(self):
-        return jnp.sum(self.data)
+        # reductions mask explicitly rather than trusting the zero-pad
+        # invariant: this keeps them correct on AD cotangents (whose pads a
+        # plain sum would make nonzero-sensitive, poisoning every gradient's
+        # pad region) and costs nothing when the matrix is unpadded
+        return jnp.sum(self._mask_padded(self.data))
 
     def elements_count(self) -> int:
         return self.num_rows()
@@ -247,14 +251,15 @@ class DenseMatrix(DistributedMatrix):
         The reference implements "1" and "inf" (largest column/row sum) and
         leaves "2"/"fro" as TODO; all four work here ("2" via power iteration)."""
         m, n = self._shape
+        data = self._mask_padded(self.data)  # see sum()
         if mode == "1":
-            return jnp.max(jnp.sum(jnp.abs(self.data), axis=0)[:n])
+            return jnp.max(jnp.sum(jnp.abs(data), axis=0)[:n])
         if mode == "inf":
-            return jnp.max(jnp.sum(jnp.abs(self.data), axis=1)[:m])
+            return jnp.max(jnp.sum(jnp.abs(data), axis=1)[:m])
         if mode == "fro":
-            return jnp.sqrt(jnp.sum(self.data * self.data))
+            return jnp.sqrt(jnp.sum(data * data))
         if mode == "2":
-            return _power_iteration_norm2(self.data)
+            return _power_iteration_norm2(data)
         raise ValueError(f"unknown norm mode: {mode}")
 
     # -------------------------------------------------------------- matmul

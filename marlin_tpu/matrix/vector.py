@@ -159,7 +159,9 @@ class DistributedVector:
         return type(self).from_array(self.logical(), mesh, self.column_major)
 
     def sum(self):
-        return jnp.sum(self.data)
+        # reduce the logical view so AD cotangents keep zero pads (the
+        # padded-array sum would be pad-sensitive; see DenseMatrix.sum)
+        return jnp.sum(self.logical())
 
     def norm(self, ord: int | float = 2):
         """Vector norm over the logical elements (negative ords would be

@@ -109,3 +109,24 @@ def test_fuse_int_vector(mesh):
     out = double(iv)
     assert isinstance(out, mt.DistributedIntVector)
     np.testing.assert_array_equal(out.to_numpy(), np.arange(6) * 2)
+
+
+def test_fuse_grad_cotangent_pads_are_zero(row_mesh):
+    # 100 rows on an 8-row-shard mesh pads to 104: the cotangent of a
+    # masked-reduction loss must keep the pad region zero, or every
+    # downstream sum/norm/update on the gradient is silently wrong
+    a = mt.DenseVecMatrix.random(0, 100, 60, mesh=row_mesh)
+    b = mt.DenseVecMatrix.random(1, 60, 80, mesh=row_mesh)
+    g = jax.grad(lambda a: a.multiply(b).sum())(a)
+    g_pad = np.asarray(g.data)
+    assert g_pad.shape[0] > 100  # padding actually present
+    assert np.all(g_pad[100:] == 0.0), "cotangent pads nonzero"
+    # reductions on the gradient agree with the logical oracle
+    ref = np.ones((100, 80), np.float32) @ b.to_numpy().T
+    np.testing.assert_allclose(float(g.sum()), ref.sum(), rtol=1e-4)
+    np.testing.assert_allclose(float(g.norm("fro")),
+                               np.linalg.norm(ref), rtol=1e-4)
+    # a gradient-descent state update keeps the invariant
+    new_a = a.subtract(g.multiply(1e-3))
+    np.testing.assert_allclose(float(new_a.sum()),
+                               (a.to_numpy() - 1e-3 * ref).sum(), rtol=1e-3)

@@ -163,8 +163,10 @@ def last_good_provenance():
                 return {
                     "value": e["value"],
                     "unit": e["unit"],
-                    "source": "BENCH_ALL.json (builder-measured on the v5e "
-                              "chip in an earlier session; see BENCHMARKS.md)",
+                    "source": "BENCH_ALL.json (measured on the v5e chip by "
+                              "bench_all.py in a PREVIOUS run — stale by "
+                              "definition when this fallback fires; see "
+                              "BENCHMARKS.md)",
                 }
     except Exception:
         pass

@@ -241,7 +241,7 @@ def config_als(users=1_000_000, items=100_000, rank=32, nnz=10_000_000,
         0.1 * rng.standard_normal(nnz).astype(np.float32)
     coo = mt.CoordinateMatrix(ui, ii, vals, shape=(users, items), mesh=mesh)
     model = coo.als(rank=rank, iterations=1, lam=0.05)  # compile + H2D
-    mt.evaluate(model.user_features)
+    mt.evaluate(model.user_features, model.product_features)
     t0 = time.perf_counter()
     model = coo.als(rank=rank, iterations=iters, lam=0.05)
     # data-dependent fetch inside the timed region: async dispatch otherwise

@@ -47,6 +47,28 @@ if MODE == "matmul":
     expected_total = float((a_np @ b_np).sum())
     total = float(jax.jit(jnp.sum)(c))  # cross-process psum under the hood
     assert abs(total - expected_total) < 1e-4, (total, expected_total)
+    # ring matmul: the ppermute pipeline crosses the process boundary
+    # (device ring 4+4 over two OS processes); global arrays span
+    # non-addressable devices, so each process checks its own shards
+    def check_shards(arr, expected, tol=1e-4):
+        for sh in arr.addressable_shards:
+            np.testing.assert_allclose(np.asarray(sh.data), expected[sh.index],
+                                       rtol=tol, atol=tol)
+
+    from marlin_tpu.parallel.ring import ring_matmul
+    rc = ring_matmul(jnp.asarray(a_np), jnp.asarray(b_np), mesh=mesh)
+    check_shards(rc, a_np @ b_np)
+    # causal ring attention around the same cross-process ring
+    from marlin_tpu.parallel.ring_attention import (attention_reference,
+                                                   ring_attention)
+    rng = np.random.default_rng(3)
+    q, k, v = (rng.standard_normal((19, 8)).astype(np.float32)
+               for _ in range(3))
+    out = ring_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                         mesh=mesh, causal=True)
+    ref = np.asarray(attention_reference(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v), causal=True))
+    check_shards(out, ref)
     print(f"proc {proc_id}: global sum ok ({total:.4f})", flush=True)
 elif MODE == "save":
     # each process writes only its addressable shards (VERDICT r1 #6)

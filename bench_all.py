@@ -18,6 +18,7 @@ the BASELINE config list:
   acc: north-star multiply row-block rel-err vs host f64 oracle + precision
        kwarg plumbing proof (default bf16 vs high f32)
   als: blocked ALS, 10^6 users x 10^5 items x rank 32 x 10^7 ratings
+  bsr: structured-sparsity SpMM (5% of 128x128 blocks), chunked vs pallas
 """
 
 import json
@@ -224,6 +225,37 @@ def config_pagerank(n=10_000_000, e=100_000_000, iterations=10):
            f"{dt:.2f} s for {iterations} iters, edges resident on chip")
 
 
+def config_bsr(grid=256, bs=128, p=256, block_density=0.05):
+    """Structured-sparsity SpMM: (grid·bs)² matrix holding ``block_density``
+    of its bs×bs blocks, times a dense (n, p) panel — chunked-einsum vs the
+    scatter-free Pallas kernel."""
+    import jax.numpy as jnp
+
+    from marlin_tpu.ops.sparse_bsr import BsrMatrix, bsr_spmm, bsr_spmm_pallas
+
+    rng = np.random.default_rng(0)
+    n = grid * bs
+    nnzb = max(1, int(grid * grid * block_density))
+    ids = np.sort(rng.choice(grid * grid, nnzb, replace=False))
+    blocks = rng.standard_normal((nnzb, bs, bs)).astype(np.float32)
+    bsr = BsrMatrix(jnp.asarray(blocks),
+                    jnp.asarray(ids // grid, jnp.int32),
+                    jnp.asarray(ids % grid, jnp.int32), (n, n), bs)
+    b = jnp.asarray(rng.standard_normal((n, p)).astype(np.float32))
+    flops = 2.0 * nnzb * bs * bs * p
+    for name, fn in (("chunked", lambda: bsr_spmm(bsr, b)),
+                     ("pallas", lambda: bsr_spmm_pallas(bsr, b))):
+        out = fn()
+        float(jnp.sum(out))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = fn()
+        float(jnp.sum(out))
+        dt = (time.perf_counter() - t0) / 5
+        record(f"bsr_{n}x{n}_bd{block_density}_{name}", flops / dt / 1e9,
+               "GFLOP/s", f"{dt * 1e3:.1f} ms, nnzb={nnzb}, bs={bs}, p={p}")
+
+
 def config_als(users=1_000_000, items=100_000, rank=32, nnz=10_000_000,
                iters=3):
     """Blocked ALS at MovieLens-10M-ish scale on one chip: wall clock per
@@ -313,6 +345,7 @@ def main():
         "pr": config_pagerank,
         "acc": config_accuracy,
         "als": config_als,
+        "bsr": config_bsr,
     }
     for k in which:
         log(f"=== config {k}")

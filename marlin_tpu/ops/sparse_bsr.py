@@ -23,12 +23,30 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["BsrMatrix", "bsr_from_dense", "bsr_from_coo", "bsr_spmm"]
+__all__ = ["BsrMatrix", "bsr_from_dense", "bsr_from_coo", "bsr_spmm",
+           "bsr_spmm_pallas"]
 
 
 class BsrMatrix:
     def __init__(self, blocks, block_rows, block_cols, shape, block_size: int):
+        # keep blocks sorted by block row: the SpMM scatter-reduce then runs
+        # indices_are_sorted (an unsorted scatter-add is a TPU perf cliff) and
+        # the Pallas path's in-VMEM output accumulation requires consecutive
+        # same-row visits. The factories already emit sorted order; this
+        # guards direct construction.
+        # (skipped for tracers: a traced construction must come from an
+        # already-sorted source. The host check reads only the (nnzb,) index
+        # vector — trivial next to the block data itself.)
+        if not isinstance(block_rows, jax.core.Tracer):
+            br = np.asarray(block_rows)
+            if br.size > 1 and np.any(br[1:] < br[:-1]):
+                order = np.argsort(br, kind="stable")
+                blocks = jnp.asarray(blocks)[order]
+                block_rows = jnp.asarray(block_rows)[order]
+                block_cols = jnp.asarray(block_cols)[order]
         self.blocks = blocks  # (nnzb, bs, bs)
         self.block_rows = block_rows  # (nnzb,) int32
         self.block_cols = block_cols  # (nnzb,) int32
@@ -53,7 +71,18 @@ class BsrMatrix:
         out = out.at[self.block_rows, self.block_cols].add(self.blocks)
         return out.transpose(0, 2, 1, 3).reshape(nbr * bs, nbc * bs)[:m, :n]
 
-    def multiply(self, b, chunk_blocks: int | None = None) -> jax.Array:
+    def multiply(self, b, chunk_blocks: int | None = None,
+                 backend: str = "chunked") -> jax.Array:
+        """``backend="pallas"`` selects the scatter-free VMEM-accumulating
+        kernel (:func:`bsr_spmm_pallas`); ``"chunked"`` the batched-einsum +
+        sorted-segment-sum formulation."""
+        if backend == "pallas":
+            if chunk_blocks is not None:
+                raise ValueError(
+                    "chunk_blocks applies only to backend='chunked'")
+            return bsr_spmm_pallas(self, b)
+        if backend != "chunked":
+            raise ValueError(f"unknown BSR backend: {backend!r}")
         return bsr_spmm(self, b, chunk_blocks)
 
     def __repr__(self):
@@ -131,14 +160,89 @@ def _bsr_spmm_chunked(blocks, brows, bcols, b_panels, n_block_rows: int,
         panels = b_panels[bcols[idx]]           # (chunk, bs, p) gather
         prod = jnp.einsum("abc,acd->abd", blk, panels,
                           preferred_element_type=accum_dtype)
-        # +1 spill row swallows padding entries routed to row n_block_rows
-        out = out + jax.ops.segment_sum(prod, brows[idx], n_block_rows + 1)
+        # +1 spill row swallows padding entries routed to row n_block_rows;
+        # rows are sorted (constructor invariant), which matters on TPU
+        out = out + jax.ops.segment_sum(prod, brows[idx], n_block_rows + 1,
+                                        indices_are_sorted=True)
         return out, None
 
     out0 = jnp.zeros((n_block_rows + 1, bs, p), accum_dtype)
     idxs = jnp.arange(n_chunks * chunk).reshape(n_chunks, chunk)
     out, _ = jax.lax.scan(body, out0, idxs)
     return out[:n_block_rows]
+
+
+def _bsr_pallas_kernel(brows, bcols, blk_ref, b_ref, o_ref):
+    j = pl.program_id(0)
+    # output block index is brows[j] (scalar-prefetch-driven index map): while
+    # consecutive programs hit the same block row, the output tile stays
+    # resident in VMEM and accumulates — no scatter anywhere. Initialize on
+    # the first visit of each row (rows are sorted, constructor invariant).
+    first = jnp.where(j == 0, True, brows[j] != brows[jnp.maximum(j - 1, 0)])
+
+    @pl.when(first)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    o_ref[:] += jnp.dot(
+        blk_ref[0], b_ref[0], preferred_element_type=jnp.float32
+    )[None]
+
+
+def bsr_spmm_pallas(bsr: BsrMatrix, b, interpret: bool | None = None) -> jax.Array:
+    """``bsr @ b`` as one Pallas pass: grid over stored blocks, B panels and
+    output tiles selected by scalar-prefetched block indices, accumulation in
+    VMEM. Versus :func:`bsr_spmm` this removes the block-row scatter-reduce
+    and the (chunk, bs, p) gather materialization entirely — each stored
+    block is one (bs×bs)@(bs×p) MXU matmul straight into the resident output
+    tile."""
+    b = jnp.asarray(b.logical() if hasattr(b, "logical") else b)
+    m, n = bsr.shape
+    if b.shape[0] != n:
+        raise ValueError(f"inner dim mismatch: {bsr.shape} @ {b.shape}")
+    bs = bsr.block_size
+    p = b.shape[1]
+    out_dtype = jnp.promote_types(bsr.blocks.dtype, b.dtype)
+    if jnp.promote_types(out_dtype, jnp.float32) != jnp.dtype(jnp.float32):
+        # the kernel computes in f32 (Mosaic has no f64 MXU path); wider
+        # operands route to the chunked formulation, which accumulates in the
+        # promoted dtype — same numerics contract as the ELL/BCOO paths
+        return bsr_spmm(bsr, b)
+    if bsr.nnzb == 0:
+        return jnp.zeros((m, p), out_dtype)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    np_ = -(-n // bs) * bs
+    pp = -(-p // 128) * 128 if not interpret else p
+    if (np_, pp) != (n, p):
+        b = jnp.pad(b, ((0, np_ - n), (0, pp - p)))
+    b_panels = b.reshape(np_ // bs, bs, pp)
+    n_block_rows = -(-m // bs)
+
+    brows = jnp.asarray(bsr.block_rows, jnp.int32)
+    bcols = jnp.asarray(bsr.block_cols, jnp.int32)
+    blocks = bsr.blocks
+    nnzb = bsr.nnzb
+    f32 = jnp.float32
+    out = pl.pallas_call(
+        _bsr_pallas_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(nnzb,),
+            in_specs=[
+                pl.BlockSpec((1, bs, bs), lambda j, br, bc: (j, 0, 0)),
+                pl.BlockSpec((1, bs, pp), lambda j, br, bc: (bc[j], 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, bs, pp), lambda j, br, bc: (br[j], 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_block_rows, bs, pp), f32),
+        interpret=interpret,
+    )(brows, bcols, blocks.astype(f32), b_panels.astype(f32))
+    # block rows with no stored blocks are never visited -> undefined; mask
+    has_blocks = jnp.zeros((n_block_rows,), bool).at[brows].set(
+        True, indices_are_sorted=True)
+    out = jnp.where(has_blocks[:, None, None], out, 0.0)
+    return out.reshape(n_block_rows * bs, pp)[:m, :p].astype(out_dtype)
 
 
 def bsr_spmm(bsr: BsrMatrix, b, chunk_blocks: int | None = None) -> jax.Array:

@@ -117,3 +117,58 @@ def test_bsr_from_coo_empty():
     assert bsr.nnzb == 0
     out = bsr_spmm(bsr, jnp.ones((64, 3)))
     assert float(jnp.abs(out).max()) == 0.0
+
+
+def test_bsr_pallas_matches_chunked():
+    from marlin_tpu.ops.sparse_bsr import bsr_from_dense, bsr_spmm, bsr_spmm_pallas
+
+    rng = np.random.default_rng(4)
+    # block-diagonal + some off-diagonal blocks, ragged edges, empty rows
+    a = np.zeros((300, 260), np.float32)
+    bs = 64
+    for (i, j) in [(0, 0), (0, 2), (2, 1), (4, 3), (4, 0)]:  # row 1,3 empty
+        a[i*bs:(i+1)*bs, j*bs:(j+1)*bs] = rng.standard_normal((bs, bs))[
+            : min(bs, 300 - i*bs), : min(bs, 260 - j*bs)]
+    b = rng.standard_normal((260, 50)).astype(np.float32)
+    bsr = bsr_from_dense(a, block_size=bs)
+    ref = a @ b
+    np.testing.assert_allclose(np.asarray(bsr_spmm(bsr, b)), ref, rtol=2e-4, atol=2e-4)
+    out = np.asarray(bsr_spmm_pallas(bsr, b))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+    # multiply() backend switch
+    out2 = np.asarray(bsr.multiply(b, backend="pallas"))
+    np.testing.assert_allclose(out2, ref, rtol=2e-4, atol=2e-4)
+    with pytest.raises(ValueError):
+        bsr.multiply(b, backend="cuda")
+
+
+def test_bsr_unsorted_construction_sorts():
+    from marlin_tpu.ops.sparse_bsr import BsrMatrix, bsr_spmm, bsr_spmm_pallas
+
+    rng = np.random.default_rng(5)
+    bs = 8
+    blocks = rng.standard_normal((3, bs, bs)).astype(np.float32)
+    # deliberately unsorted rows
+    bsr = BsrMatrix(jnp.asarray(blocks), jnp.asarray([2, 0, 2], jnp.int32),
+                    jnp.asarray([1, 0, 0], jnp.int32), (24, 16), bs)
+    dense = np.asarray(bsr.to_dense())
+    b = rng.standard_normal((16, 9)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(bsr_spmm(bsr, b)), dense @ b,
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(bsr_spmm_pallas(bsr, b)), dense @ b,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_bsr_pallas_f64_routes_to_chunked():
+    # x64 off in this suite: emulate by checking the promote guard directly —
+    # f64 blocks would demote; here we assert the f32 path produces f32 and
+    # that an f64-typed request falls back without error when x64 is enabled
+    from marlin_tpu.ops.sparse_bsr import bsr_from_dense, bsr_spmm_pallas
+
+    rng = np.random.default_rng(6)
+    a = np.zeros((64, 64), np.float64)
+    a[:32, :32] = rng.standard_normal((32, 32))
+    bsr = bsr_from_dense(a, block_size=32)
+    b = rng.standard_normal((64, 8))
+    out = bsr_spmm_pallas(bsr, b)  # wider-than-f32 inputs: chunked fallback
+    np.testing.assert_allclose(np.asarray(out), a @ b, rtol=1e-4, atol=1e-4)

@@ -123,8 +123,12 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
             alpha = jnp.exp(m - m_new)
             p_ = jnp.exp(s - m_new[:, None])
             l = l * alpha + jnp.sum(p_, axis=-1)
+            # p cast to v's dtype: f32 inputs keep the f32 "highest" path;
+            # bf16 inputs (precision="default") run a native bf16 MXU matmul
+            # with f32 accumulation — the flash kernel makes the same cast
             acc = acc * alpha[:, None] + jnp.dot(
-                p_, v_t.astype(jnp.float32), precision="highest"
+                p_.astype(v_t.dtype), v_t, precision="highest",
+                preferred_element_type=jnp.float32,
             )
             return m_new, l, acc
 
@@ -173,6 +177,7 @@ def ring_attention(
     causal: bool = False,
     scale: float | None = None,
     backend: str = "auto",
+    precision: str = "high",
 ) -> jax.Array:
     """Exact attention with the sequence sharded over ``axis``.
 
@@ -183,11 +188,22 @@ def ring_attention(
     ``backend``: ``"flash"`` runs each panel through the Pallas flash kernel
     (score tiles stay in VMEM, causal blocks below the diagonal skipped);
     ``"xla"`` keeps the tiled XLA formulation; ``"auto"`` picks flash on TPU
-    for MXU-friendly head dims and XLA elsewhere."""
+    for MXU-friendly head dims and XLA elsewhere.
+
+    ``precision``: ``"high"`` computes the QKᵀ and PV matmuls on the operands'
+    own dtype (f32 in → f32 MXU passes); ``"default"`` casts Q/K/V to
+    bfloat16 for the matmuls — the standard production-attention contract
+    (softmax statistics and the output accumulator stay f32; only the MXU
+    operands narrow). Measured at d=128/seq=32k the two are within noise of
+    each other (the kernel is softmax/VPU-bound there, BENCHMARKS.md); the
+    bf16 MXU advantage materializes at larger head dims where the matmuls
+    dominate. Mirrors the ``precision`` knob of ``DenseVecMatrix.multiply``."""
     if q.ndim not in (2, 3) or k.shape != q.shape or v.shape != q.shape:
         raise ValueError(f"q/k/v shape mismatch: {q.shape} {k.shape} {v.shape}")
     if backend not in ("auto", "flash", "xla"):
         raise ValueError(f"unknown ring attention backend: {backend!r}")
+    if precision not in ("high", "default"):
+        raise ValueError(f"unknown ring attention precision: {precision!r}")
     seq, d = q.shape[-2], q.shape[-1]
     mesh = mesh or default_mesh()
     p_size = mesh.shape[axis]
@@ -209,6 +225,9 @@ def ring_attention(
     pad = ((0, 0),) * (q.ndim - 2) + ((0, sp - seq), (0, 0))
     if sp != seq:
         q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+    out_dtype = q.dtype
+    if precision == "default":
+        q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
     scale_val = float(scale if scale is not None else 1.0 / math.sqrt(d))
     # sharding is placed on the SEQUENCE axis here, before any head vmap —
     # sharding inside the vmapped function would partition the heads axis
@@ -221,4 +240,5 @@ def ring_attention(
         out = jax.vmap(lambda qh, kh, vh: f(qh, kh, vh, vl))(q, k, v)
     else:
         out = f(q, k, v, vl)
+    out = out.astype(out_dtype)
     return out[..., :seq, :] if sp != seq else out

@@ -110,6 +110,26 @@ def test_ring_attention_bad_backend(mesh):
         ring_attention(q, k, v, mesh, backend="cuda")
 
 
+@pytest.mark.parametrize("backend", ["xla", "flash"])
+def test_ring_attention_bf16_precision(mesh, backend):
+    # precision="default" narrows the MXU operands to bf16 but keeps softmax
+    # statistics and the accumulator f32 — ~1e-2 relative class, f32 output
+    # dtype preserved
+    q, k, v = _qkv(100, 32, 11)
+    out = ring_attention(q, k, v, mesh, causal=True, backend=backend,
+                         precision="default")
+    assert out.dtype == q.dtype
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_ring_attention_bad_precision(mesh):
+    q, k, v = _qkv(16, 8, 8)
+    with pytest.raises(ValueError):
+        ring_attention(q, k, v, mesh, precision="low")
+
+
 def test_flash_xla_equivalence_sweep(mesh):
     # property sweep: both backends must agree with the dense oracle across
     # random shapes, head dims, causality, and ragged lengths

@@ -240,12 +240,16 @@ def als_run(ratings, rank: int, iterations: int = 10, lam: float = 0.01,
     blocking was the reference's shuffle-partitioning knob, and factor layout
     here is governed by the mesh sharding instead.
 
-    ``shard`` selects the mesh-sharded solver (segment axes of the factor
-    matrices and stat accumulators sharded over all devices, the fixed side
+    ``shard`` selects the blocked solver (segment axes of the factor matrices
+    and stat accumulators sharded over all devices, the fixed side
     all-gathered per half-step) — the scale path matching the reference's
-    MEMORY_AND_DISK blocked design (ALSHelp.scala:32, 263-286). ``None``
-    auto-enables it when the full stat tensor of either side would exceed
-    256 MB. ``segment_block`` is the per-device solve granularity.
+    MEMORY_AND_DISK blocked design (ALSHelp.scala:32, 263-286). On a single
+    device it is the bounded-memory mode: stats materialize one
+    ``segment_block`` at a time instead of ``(num_segments, rank, rank)`` at
+    once, which is what lets reference-scale rating sets (10⁶+ users) fit one
+    chip's HBM. ``None`` auto-enables it when the full stat tensor of either
+    side would exceed 256 MB. ``segment_block`` is the per-device solve
+    granularity.
     """
     del num_user_blocks, num_product_blocks
     from ..matrix.dense import DenseVecMatrix
@@ -264,10 +268,13 @@ def als_run(ratings, rank: int, iterations: int = 10, lam: float = 0.01,
 
     n_dev = int(np.prod(list(mesh.shape.values())))
     if shard is None:
+        # blocked mode whenever the full stat tensor is HBM-hostile — on ANY
+        # device count (the single-chip ALS bench config needs 31 GB of stats
+        # through the unsharded path; blocked, it needs one segment block)
         stat_bytes = 4 * rank * rank * max(num_users, num_items)
-        shard = n_dev > 1 and stat_bytes > (1 << 28)
+        shard = stat_bytes > (1 << 28)
 
-    if shard and n_dev > 1:
+    if shard:
         u, v = _als_sharded(mesh, u, v, users, items, vals, num_users,
                             num_items, iterations, lam, alpha, weighted_lambda,
                             implicit_prefs, segment_block, n_dev)

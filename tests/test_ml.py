@@ -62,6 +62,49 @@ def test_als_sharded_matches_replicated(mesh):
     assert sh.rmse(coo) < 0.3
 
 
+def test_als_blocked_single_device(mesh):
+    # shard=True on a ONE-device mesh is the bounded-memory blocked mode (the
+    # single-chip ALS bench config OOMs through the unsharded path: 31 GB of
+    # (num_users, rank, rank) stats vs 16 GB HBM); it must agree with the
+    # unsharded solver
+    import jax
+
+    mesh1 = mt.create_mesh((1, 1), devices=jax.devices()[:1])
+    coo = _rating_fixture(7, 50, 30, 4, 0.5, mesh1)
+    rep = coo.als(rank=4, iterations=5, lam=0.05, shard=False)
+    blk = coo.als(rank=4, iterations=5, lam=0.05, shard=True, segment_block=8)
+    np.testing.assert_allclose(blk.user_features.to_numpy(),
+                               rep.user_features.to_numpy(),
+                               rtol=2e-3, atol=2e-3)
+    assert blk.rmse(coo) < 0.3
+
+
+def test_als_auto_shard_threshold(mesh):
+    # the auto heuristic keys on stat-tensor size alone (device count no
+    # longer gates it): big segment side -> blocked mode even on 1 device
+    from marlin_tpu.ml import als as als_mod
+
+    calls = {}
+    orig = als_mod._als_sharded
+
+    def spy(*a, **k):
+        calls["sharded"] = True
+        return orig(*a, **k)
+
+    als_mod._als_sharded = spy
+    try:
+        # 300k users x rank 16: stats 4*16*16*300k = 307 MB > 256 MB
+        rng = np.random.default_rng(8)
+        ui = rng.integers(0, 300_000, 500).astype(np.int32)
+        ii = rng.integers(0, 20, 500).astype(np.int32)
+        coo = mt.CoordinateMatrix(ui, ii, rng.standard_normal(500).astype(np.float32),
+                                  shape=(300_000, 20), mesh=mesh)
+        coo.als(rank=16, iterations=1, lam=0.1)
+    finally:
+        als_mod._als_sharded = orig
+    assert calls.get("sharded"), "auto shard heuristic did not engage"
+
+
 def test_als_sharded_implicit_matches_replicated(mesh):
     rng = np.random.default_rng(3)
     n_users, n_items = 40, 24

@@ -6,6 +6,8 @@ no analog — block size comes from the config (`lu_base_size`)."""
 
 import sys
 
+import numpy as np
+
 from examples._common import die, millis
 
 
@@ -30,7 +32,9 @@ def main(argv=None):
     l.save_to_file_system(out + ".L")
     u.save_to_file_system(out + ".U")
     with open(out + ".perm", "w") as f:
-        f.write(",".join(map(str, p)))
+        # one bulk fetch — p is a device array; element iteration would issue
+        # a device round trip per row
+        f.write(",".join(map(str, np.asarray(p))))
     print(f"saved {out}.L / {out}.U / {out}.perm")
 
 

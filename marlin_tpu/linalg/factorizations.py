@@ -7,14 +7,19 @@ the factors back, applies panel updates, and shuffle-multiplies the trailing
 submatrix (DenseVecMatrix.scala:283-466 LU, 475-561 Cholesky, 568-764 inverse).
 The per-iteration driver round-trip is its scalability bottleneck (SURVEY.md §3.3).
 
-TPU-first, the whole factorization is ONE jitted XLA program: a
-``lax.fori_loop`` over block columns where the pivot block is factorized
-*on-device* (``jax.lax.linalg.lu`` / ``jnp.linalg.cholesky`` on a b×b slice —
-the "collect+broadcast" disappears into XLA's implicit data movement), panel
-updates are masked triangular solves over full-width panels (static shapes for
-XLA; masks replace the shrinking trailing extents), and the trailing update is
-a full-size rank-b GEMM with masked operands — zero contribution outside the
-trailing region, so no dynamic shapes anywhere.
+TPU-first, the whole factorization is ONE jitted XLA program with the pivot
+block factorized *on-device* (``jax.lax.linalg.lu`` / ``jnp.linalg.cholesky``
+on a b×b slice — the "collect+broadcast" disappears into XLA's implicit data
+movement). Two schedules exist (``schedule=`` on the public functions):
+
+- ``"shrinking"`` (default up to 64 block steps): the Python loop over block
+  columns unrolls at trace time, so every step's panel/trailing slices have
+  their true static shrinking shapes — the ideal 2n³/3 FLOPs, at the cost of
+  one compiled GEMM shape per step.
+- ``"masked"``: a single ``lax.fori_loop`` body reused for every step —
+  full-width panels with masked operands (zero contribution outside the
+  trailing region), one compiled shape total but ~3× the ideal FLOPs. This is
+  the scalable-step-count form and the only one for ``pivot="panel"``.
 
 Pivoting: the default (``pivot="block"``) matches the reference's choice —
 partial pivoting *within the pivot block only* (the reference LUs just the
@@ -53,9 +58,35 @@ from jax.sharding import NamedSharding
 from ..config import get_config
 from ..mesh import pad_to_multiple
 
-__all__ = ["lu_decompose", "cholesky_decompose", "inverse", "PIVOT_STRATEGIES"]
+__all__ = ["lu_decompose", "cholesky_decompose", "inverse", "PIVOT_STRATEGIES",
+           "SCHEDULES"]
 
 PIVOT_STRATEGIES = ("block", "panel")
+SCHEDULES = ("auto", "shrinking", "masked")
+
+# above this many block steps the unrolled shrinking schedule's per-step
+# compilation cost outweighs its 3x FLOP saving; fall back to the single
+# fori_loop program
+_MAX_UNROLL_STEPS = 64
+
+
+def _require_pivot(pivot: str) -> None:
+    if pivot not in PIVOT_STRATEGIES:
+        raise ValueError(
+            f"unknown pivot strategy: {pivot!r} (one of {PIVOT_STRATEGIES})"
+        )
+
+
+def _resolve_schedule(schedule: str, nb: int, pivot: str = "block") -> str:
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown schedule: {schedule!r} (one of {SCHEDULES})")
+    if schedule == "shrinking" and pivot == "panel":
+        raise ValueError('schedule="shrinking" supports pivot="block" only '
+                         '(panel pivoting keeps the masked full-width loop)')
+    if schedule == "auto":
+        return ("shrinking" if pivot == "block" and nb <= _MAX_UNROLL_STEPS
+                else "masked")
+    return schedule
 
 
 def _pad_with_identity(a: jax.Array, n_pad: int) -> jax.Array:
@@ -230,6 +261,80 @@ def _blocked_lu_panel_pivot(a: jax.Array, block: int, sharding=None):
 
 
 @functools.partial(jax.jit, static_argnames=("block", "sharding"))
+def _blocked_lu_shrinking(a: jax.Array, block: int, sharding=None):
+    """Right-looking blocked LU, block-local pivoting, *shrinking-extent*
+    schedule: the step offsets are static, so the Python loop unrolls at trace
+    time and every panel/trailing slice has its true (shrinking) static shape —
+    no masks, no wasted work. The masked ``_blocked_lu`` executes ~3× the
+    ideal 2n³/3 FLOPs (full-width rank-b GEMMs with zero-masked operands);
+    this schedule executes the ideal count at the cost of one compiled GEMM
+    shape per block step (fine for the tens of steps real sizes produce)."""
+    n = a.shape[0]
+    nb = n // block
+    solve = jax.scipy.linalg.solve_triangular
+    gperm = jnp.arange(n, dtype=jnp.int32)
+    eye_b = jnp.eye(block, dtype=a.dtype)
+
+    for i in range(nb):
+        o = i * block
+        piv = jax.lax.slice(a, (o, o), (o + block, o + block))
+        lu, _, p = jax.lax.linalg.lu(piv)
+        l11 = jnp.tril(lu, -1) + eye_b
+        u11 = jnp.triu(lu)
+        l11_inv = solve(l11, eye_b, lower=True, unit_diagonal=True)
+        u11_inv = solve(u11.T, eye_b, lower=True).T
+
+        # permute the whole row stripe (columns left of the panel carry
+        # already-final L entries and must swap with it, like laswp)
+        stripe = jax.lax.slice(a, (o, 0), (o + block, n))[p]
+        gseg = jax.lax.dynamic_slice(gperm, (o,), (block,))
+        gperm = jax.lax.dynamic_update_slice(gperm, gseg[p], (o,))
+
+        a = jax.lax.dynamic_update_slice(a, stripe, (o, 0))
+        a = jax.lax.dynamic_update_slice(a, lu, (o, o))
+        if o + block < n:
+            right = stripe[:, o + block:]
+            u12 = jnp.dot(l11_inv, right, precision="highest")
+            below = jax.lax.slice(a, (o + block, o), (n, o + block))
+            l21 = jnp.dot(below, u11_inv, precision="highest")
+            trail = jax.lax.slice(a, (o + block, o + block), (n, n))
+            trail = trail - jnp.dot(l21, u12, precision="highest")
+            a = jax.lax.dynamic_update_slice(a, u12, (o, o + block))
+            a = jax.lax.dynamic_update_slice(a, l21, (o + block, o))
+            a = jax.lax.dynamic_update_slice(a, trail, (o + block, o + block))
+        if sharding is not None:
+            a = jax.lax.with_sharding_constraint(a, sharding)
+    return a, gperm
+
+
+@functools.partial(jax.jit, static_argnames=("block", "sharding"))
+def _blocked_cholesky_shrinking(a: jax.Array, block: int, sharding=None):
+    """Shrinking-extent blocked Cholesky (lower) — same schedule trade as
+    :func:`_blocked_lu_shrinking`."""
+    n = a.shape[0]
+    nb = n // block
+    solve = jax.scipy.linalg.solve_triangular
+    eye_b = jnp.eye(block, dtype=a.dtype)
+
+    for i in range(nb):
+        o = i * block
+        piv = jax.lax.slice(a, (o, o), (o + block, o + block))
+        l11 = jnp.linalg.cholesky(piv)
+        a = jax.lax.dynamic_update_slice(a, l11, (o, o))
+        if o + block < n:
+            l11_inv = solve(l11, eye_b, lower=True)
+            below = jax.lax.slice(a, (o + block, o), (n, o + block))
+            l21 = jnp.dot(below, l11_inv.T, precision="highest")
+            trail = jax.lax.slice(a, (o + block, o + block), (n, n))
+            trail = trail - jnp.dot(l21, l21.T, precision="highest")
+            a = jax.lax.dynamic_update_slice(a, l21, (o + block, o))
+            a = jax.lax.dynamic_update_slice(a, trail, (o + block, o + block))
+        if sharding is not None:
+            a = jax.lax.with_sharding_constraint(a, sharding)
+    return jnp.tril(a)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "sharding"))
 def _blocked_cholesky(a: jax.Array, block: int, sharding=None):
     """Right-looking blocked Cholesky (lower). No pivoting (SPD input)."""
     n = a.shape[0]
@@ -282,38 +387,48 @@ def _mode_to_local(mode: str, n: int) -> bool:
 
 
 def lu_decompose(mat, mode: str = "auto", block_size: int | None = None,
-                 pivot: str = "block"):
+                 pivot: str = "block", schedule: str = "auto"):
     """Block LU with partial pivoting (DenseVecMatrix.luDecompose,
     DenseVecMatrix.scala:283-466). Returns ``(L, U, perm)`` where ``perm`` is
-    the row-permutation vector: ``A[perm] == L @ U``.
+    the row-permutation vector: ``A[perm] == L @ U``. ``perm`` stays a device
+    array — forcing it to host here would insert a blocking sync into every
+    call (dispatch is async; fetch when you need the values).
 
     ``pivot``: "block" restricts pivot search to the b×b pivot block (the
     reference's choice — fast, weaker on adversarial inputs); "panel" searches
     the full trailing column per elimination step (LAPACK getrf behavior —
-    handles e.g. a singular pivot block with good pivots below it)."""
+    handles e.g. a singular pivot block with good pivots below it).
+
+    ``schedule``: "shrinking" unrolls the block steps with true shrinking
+    trailing extents (ideal 2n³/3 FLOPs, one compiled GEMM shape per step);
+    "masked" is the single fori_loop program with full-width masked updates
+    (~3× the FLOPs, one compiled shape total). "auto" picks shrinking for
+    block-pivot factorizations up to 64 steps."""
     _require_square(mat)
+    _require_pivot(pivot)
+    _resolve_schedule(schedule, 1, pivot)  # arg validation in EVERY mode
     n = mat.num_rows()
     a = mat.logical()
     if _mode_to_local(mode, n):
         lu, _, p = jax.lax.linalg.lu(a)
         l = jnp.tril(lu, -1) + jnp.eye(n, dtype=a.dtype)
         u = jnp.triu(lu)
-        return mat._wrap(l), mat._wrap(u), np.asarray(jax.device_get(p))
+        return mat._wrap(l), mat._wrap(u), p
 
     b = block_size or get_config().lu_base_size
     b = min(b, n)
     n_pad, sharding = _pad_and_sharding(mat, n, b)
     a_pad = _pad_with_identity(a, n_pad)
-    if pivot not in PIVOT_STRATEGIES:
-        raise ValueError(
-            f"unknown pivot strategy: {pivot!r} (one of {PIVOT_STRATEGIES})"
-        )
-    factor = _blocked_lu_panel_pivot if pivot == "panel" else _blocked_lu
+    sched = _resolve_schedule(schedule, n_pad // b, pivot)
+    if pivot == "panel":
+        factor = _blocked_lu_panel_pivot
+    else:
+        factor = _blocked_lu_shrinking if sched == "shrinking" else _blocked_lu
     lu_pad, perm = factor(a_pad, b, sharding)
     lu_log = lu_pad[:n, :n]
     l = jnp.tril(lu_log, -1) + jnp.eye(n, dtype=a.dtype)
     u = jnp.triu(lu_log)
-    return mat._wrap(l), mat._wrap(u), np.asarray(jax.device_get(perm[:n]))
+    return mat._wrap(l), mat._wrap(u), perm[:n]
 
 
 def _grid(mat) -> int:
@@ -332,10 +447,13 @@ def _pad_and_sharding(mat, n: int, block: int):
     return n_pad, NamedSharding(mat.mesh, mat.spec)
 
 
-def cholesky_decompose(mat, mode: str = "auto", block_size: int | None = None):
+def cholesky_decompose(mat, mode: str = "auto", block_size: int | None = None,
+                       schedule: str = "auto"):
     """Block Cholesky, lower factor (DenseVecMatrix.choleskyDecompose,
-    DenseVecMatrix.scala:475-561). Returns L with ``A == L @ Lᵀ``."""
+    DenseVecMatrix.scala:475-561). Returns L with ``A == L @ Lᵀ``.
+    ``schedule`` as in :func:`lu_decompose`."""
     _require_square(mat)
+    _resolve_schedule(schedule, 1)  # arg validation in EVERY mode
     n = mat.num_rows()
     a = mat.logical()
     if _mode_to_local(mode, n):
@@ -344,14 +462,22 @@ def cholesky_decompose(mat, mode: str = "auto", block_size: int | None = None):
     b = min(b, n)
     n_pad, sharding = _pad_and_sharding(mat, n, b)
     a_pad = _pad_with_identity(a, n_pad)
-    l_pad = _blocked_cholesky(a_pad, b, sharding)
+    sched = _resolve_schedule(schedule, n_pad // b)
+    chol = (_blocked_cholesky_shrinking if sched == "shrinking"
+            else _blocked_cholesky)
+    l_pad = chol(a_pad, b, sharding)
     return mat._wrap(l_pad[:n, :n])
 
 
-@functools.partial(jax.jit, static_argnames=("block", "pivot", "sharding"))
+@functools.partial(jax.jit,
+                   static_argnames=("block", "pivot", "sharding", "schedule"))
 def _inverse_via_lu(a: jax.Array, block: int, pivot: str = "block",
-                    sharding=None):
-    factor = _blocked_lu_panel_pivot if pivot == "panel" else _blocked_lu
+                    sharding=None, schedule: str = "masked"):
+    if pivot == "panel":
+        factor = _blocked_lu_panel_pivot
+    else:
+        factor = (_blocked_lu_shrinking if schedule == "shrinking"
+                  else _blocked_lu)
     lu_pad, perm = factor(a, block, sharding)
     n = a.shape[0]
     solve = jax.scipy.linalg.solve_triangular
@@ -363,26 +489,26 @@ def _inverse_via_lu(a: jax.Array, block: int, pivot: str = "block",
 
 
 def inverse(mat, mode: str = "auto", block_size: int | None = None,
-            pivot: str = "block"):
+            pivot: str = "block", schedule: str = "auto"):
     """Matrix inverse (DenseVecMatrix.inverse, DenseVecMatrix.scala:568-764).
     The reference runs a blocked Gauss-Jordan-style forward + backward sweep
     with driver-factorized pivots; here it is blocked LU + two sharded
     triangular solves in one XLA program.
 
     ``pivot`` mirrors :func:`lu_decompose`: "panel" routes through the
-    full-height panel-pivoted LU for ill-conditioned pivot blocks."""
+    full-height panel-pivoted LU for ill-conditioned pivot blocks.
+    ``schedule`` as in :func:`lu_decompose` (applies to the LU stage)."""
     _require_square(mat)
+    _require_pivot(pivot)
+    _resolve_schedule(schedule, 1, pivot)  # arg validation in EVERY mode
     n = mat.num_rows()
     a = mat.logical()
     if _mode_to_local(mode, n):
         return mat._wrap(jnp.linalg.inv(a))
-    if pivot not in PIVOT_STRATEGIES:
-        raise ValueError(
-            f"unknown pivot strategy: {pivot!r} (one of {PIVOT_STRATEGIES})"
-        )
     b = block_size or get_config().inverse_base_size
     b = min(b, n)
     n_pad, sharding = _pad_and_sharding(mat, n, b)
     a_pad = _pad_with_identity(a, n_pad)
-    inv_pad = _inverse_via_lu(a_pad, b, pivot, sharding)
+    sched = _resolve_schedule(schedule, n_pad // b, pivot)
+    inv_pad = _inverse_via_lu(a_pad, b, pivot, sharding, sched)
     return mat._wrap(inv_pad[:n, :n])

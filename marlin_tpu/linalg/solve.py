@@ -62,7 +62,9 @@ def lu_solve(l, u, perm, b):
     the same logical shape."""
     l_arr, rhs, was_vector = _factor_and_rhs(l, b)
     u_arr = _as_array(u)
-    x = _lu_solve_jit(l_arr, u_arr, jnp.asarray(np.asarray(perm)), rhs)
+    # jnp.asarray handles device arrays, numpy, and lists alike — no host
+    # round trip (perm now stays on device through the whole solve chain)
+    x = _lu_solve_jit(l_arr, u_arr, jnp.asarray(perm), rhs)
     return x[:, 0] if was_vector else x
 
 

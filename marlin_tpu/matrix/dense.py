@@ -488,20 +488,20 @@ class DenseMatrix(DistributedMatrix):
         )
 
     # --------------------------------------------------------- factorizations
-    def lu_decompose(self, mode: str = "auto"):
+    def lu_decompose(self, mode: str = "auto", **kwargs):
         from ..linalg import lu_decompose
 
-        return lu_decompose(self, mode=mode)
+        return lu_decompose(self, mode=mode, **kwargs)
 
-    def cholesky_decompose(self, mode: str = "auto"):
+    def cholesky_decompose(self, mode: str = "auto", **kwargs):
         from ..linalg import cholesky_decompose
 
-        return cholesky_decompose(self, mode=mode)
+        return cholesky_decompose(self, mode=mode, **kwargs)
 
-    def inverse(self, mode: str = "auto"):
+    def inverse(self, mode: str = "auto", **kwargs):
         from ..linalg import inverse
 
-        return inverse(self, mode=mode)
+        return inverse(self, mode=mode, **kwargs)
 
     def compute_svd(self, k: int, mode: str = "auto", **kwargs):
         from ..linalg import compute_svd

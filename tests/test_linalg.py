@@ -68,6 +68,66 @@ def test_inverse(mesh, mode, block):
     np.testing.assert_allclose(inv.to_numpy() @ a, np.eye(n), atol=1e-2)
 
 
+def test_lu_schedules_agree(mesh):
+    # shrinking (unrolled true-extent) and masked (fori_loop full-width) are
+    # the same algorithm scheduled differently — identical pivots, so results
+    # agree to FP reassociation
+    n = 24
+    a = _well_conditioned(n, 4)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    outs = {}
+    for sched in ("shrinking", "masked"):
+        l, u, p = mt.linalg.lu_decompose(m, mode="dist", block_size=8,
+                                         schedule=sched)
+        np.testing.assert_allclose(a[p], l.to_numpy() @ u.to_numpy(),
+                                   rtol=1e-3, atol=1e-3)
+        outs[sched] = (l.to_numpy(), u.to_numpy(), p)
+    np.testing.assert_allclose(outs["shrinking"][0], outs["masked"][0],
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(outs["shrinking"][2], outs["masked"][2])
+
+
+def test_cholesky_schedules_agree(mesh):
+    n = 21
+    a = _spd(n, 5)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    ls = [mt.linalg.cholesky_decompose(m, mode="dist", block_size=7,
+                                       schedule=s).to_numpy()
+          for s in ("shrinking", "masked")]
+    np.testing.assert_allclose(ls[0], ls[1], rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(ls[0] @ ls[0].T, a, rtol=1e-3, atol=1e-2)
+
+
+def test_inverse_schedules_agree(mesh):
+    n = 16
+    a = _well_conditioned(n, 6)
+    m = mt.BlockMatrix.from_array(a, mesh)
+    invs = [mt.linalg.inverse(m, mode="dist", block_size=8,
+                              schedule=s).to_numpy()
+            for s in ("shrinking", "masked")]
+    np.testing.assert_allclose(invs[0], invs[1], rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(invs[0] @ a, np.eye(n), atol=1e-2)
+
+
+def test_shrinking_schedule_rejects_panel_pivot(mesh):
+    m = mt.BlockMatrix.from_array(_well_conditioned(16, 7), mesh)
+    with pytest.raises(ValueError):
+        mt.linalg.lu_decompose(m, mode="dist", block_size=8, pivot="panel",
+                               schedule="shrinking")
+    with pytest.raises(ValueError):
+        mt.linalg.lu_decompose(m, mode="dist", block_size=8, schedule="eager")
+    # arg validation must not depend on the mode taken (local short-circuits
+    # before the dist machinery)
+    with pytest.raises(ValueError):
+        mt.linalg.lu_decompose(m, mode="local", schedule="eager")
+    with pytest.raises(ValueError):
+        mt.linalg.cholesky_decompose(m, mode="local", schedule="eager")
+    with pytest.raises(ValueError):
+        mt.linalg.inverse(m, mode="local", schedule="eager")
+    with pytest.raises(ValueError):
+        mt.linalg.inverse(m, mode="local", pivot="bogus")
+
+
 @pytest.mark.parametrize("mode", ["local-svd", "local-eigs", "dist-eigs"])
 def test_svd(mesh, mode):
     rng = np.random.default_rng(3)

@@ -87,6 +87,23 @@ def test_lu_schedules_agree(mesh):
     np.testing.assert_array_equal(outs["shrinking"][2], outs["masked"][2])
 
 
+def test_lu_shrinking_pivots_inside_blocks(mesh):
+    # tiny leading diagonal entries force genuine row swaps inside each pivot
+    # block; the shrinking schedule must carry them across the full stripe
+    # (including the already-written L columns left of the panel)
+    n = 24
+    rng = np.random.default_rng(9)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    a[np.arange(n), np.arange(n)] = 1e-8  # every block pivots
+    m = mt.BlockMatrix.from_array(a, mesh)
+    l, u, p = mt.linalg.lu_decompose(m, mode="dist", block_size=8,
+                                     schedule="shrinking")
+    p = np.asarray(p)
+    assert not np.array_equal(p, np.arange(n)), "expected non-trivial pivoting"
+    np.testing.assert_allclose(a[p], l.to_numpy() @ u.to_numpy(),
+                               rtol=2e-3, atol=2e-3)
+
+
 def test_cholesky_schedules_agree(mesh):
     n = 21
     a = _spd(n, 5)

@@ -1,7 +1,9 @@
-"""Long-sequence ring attention demo (no reference analog — the long-context
+"""Long-sequence attention demo (no reference analog — the long-context
 capability the TPU rebuild adds; see docs/parallelism.md).
 
-args: ``<sequence length> [head dim] [causal 0|1] [heads]``
+args: ``<sequence length> [head dim] [causal 0|1] [heads] [strategy]``
+``strategy``: "ring" (default) or "ulysses" (all-to-all head-parallel;
+needs ``heads`` divisible by the mesh's "rows" axis).
 """
 
 import sys
@@ -14,11 +16,17 @@ from examples._common import die, millis
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) < 1:
-        die("usage: attention <sequence length> [head dim] [causal 0|1] [heads]")
+        die("usage: attention <sequence length> [head dim] [causal 0|1] "
+            "[heads] [ring|ulysses]")
     seq = int(argv[0])
     d = int(argv[1]) if len(argv) > 1 else 128
     causal = bool(int(argv[2])) if len(argv) > 2 else True
     heads = int(argv[3]) if len(argv) > 3 else 0
+    strategy = argv[4] if len(argv) > 4 else "ring"
+    if strategy not in ("ring", "ulysses"):
+        die(f"unknown strategy {strategy!r} (ring|ulysses)")
+    if strategy == "ulysses" and not heads:
+        die("ulysses needs an explicit head count (heads % mesh rows == 0)")
 
     import jax.numpy as jnp
 
@@ -30,17 +38,18 @@ def main(argv=None):
     q, k, v = (jnp.asarray(rng.standard_normal(shape).astype(np.float32))
                for _ in range(3))
 
-    out = mt.ring_attention(q, k, v, mesh, causal=causal)  # compile
+    attn = mt.ring_attention if strategy == "ring" else mt.ulysses_attention
+    out = attn(q, k, v, mesh, causal=causal)  # compile
     float(jnp.sum(out))
     t0 = millis()
-    out = mt.ring_attention(q, k, v, mesh, causal=causal)
+    out = attn(q, k, v, mesh, causal=causal)
     float(jnp.sum(out))
     dt = millis() - t0
     n_heads = heads or 1
     flops = 4.0 * n_heads * seq * seq * d * (0.5 if causal else 1.0)
     ring = mesh.shape.get("rows", 1)
-    print(f"seq={seq} d={d} heads={n_heads} causal={causal} ring={ring}: "
-          f"{dt:.1f} millis, ~{flops / dt / 1e6:.1f} GFLOP/s")
+    print(f"seq={seq} d={d} heads={n_heads} causal={causal} ring={ring} "
+          f"{strategy}: {dt:.1f} millis, ~{flops / dt / 1e6:.1f} GFLOP/s")
 
 
 if __name__ == "__main__":

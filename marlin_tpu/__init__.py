@@ -37,7 +37,14 @@ from .matrix import (  # noqa: F401
     OutOfCoreMatrix,
     SparseVecMatrix,
 )
-from .parallel import matmul, ring_attention, ring_matmul, rmm_matmul, split_method  # noqa: F401
+from .parallel import (  # noqa: F401
+    matmul,
+    ring_attention,
+    ring_matmul,
+    rmm_matmul,
+    split_method,
+    ulysses_attention,
+)
 from .linalg import cholesky_decompose, compute_svd, inverse, lanczos, lu_decompose  # noqa: F401
 from .io import (  # noqa: F401
     load_block_matrix_file,

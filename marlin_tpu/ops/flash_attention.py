@@ -28,9 +28,20 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention_panel"]
+__all__ = ["flash_attention_panel", "block_divisor"]
 
 _NEG = -1e30
+
+
+def block_divisor(n: int, cap: int = 1024) -> int:
+    """Largest power-of-two ≤ cap dividing n — the flash block-size policy
+    shared by every caller of :func:`flash_attention_panel` (ring + ulysses).
+    Callers pad panels to 128 multiples so this never degenerates below the
+    (8, 128) f32 tile Mosaic wants."""
+    b = 1
+    while b < cap and n % (b * 2) == 0:
+        b *= 2
+    return b
 
 
 def _panel_kernel(s_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,

@@ -2,4 +2,5 @@ from .carma import split_method, dim_to_split  # noqa: F401
 from .matmul import matmul, rmm_matmul, broadcast_matmul, gspmd_matmul  # noqa: F401
 from .ring import ring_matmul  # noqa: F401
 from .ring_attention import ring_attention, attention_reference  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
 from .streaming import streamed_matmul, streamed_gramian  # noqa: F401

@@ -49,13 +49,9 @@ def attention_reference(q, k, v, causal: bool = False, scale: float | None = Non
 
 _KV_TILE = 2048  # inner tile bounding the (sq × tile) score buffer
 
-
-def _block_divisor(n: int, cap: int = 1024) -> int:
-    """Largest power-of-two ≤ cap dividing n (flash block size picker)."""
-    b = 1
-    while b < cap and n % (b * 2) == 0:
-        b *= 2
-    return b
+# the flash block-size policy lives next to the kernel; re-exported here for
+# back-compat with callers/tests that imported it from this module
+from ..ops.flash_attention import block_divisor as _block_divisor  # noqa: E402
 
 
 @functools.lru_cache(maxsize=32)

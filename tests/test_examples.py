@@ -122,6 +122,15 @@ def test_nn_cli(capsys):
     assert "train accuracy" in out
 
 
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_attention_cli(capsys, strategy):
+    from examples.attention import main
+
+    main(["64", "16", "1", "4", strategy])
+    out = capsys.readouterr().out
+    assert strategy in out and "GFLOP/s" in out
+
+
 @pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
 def test_genmat_tool(tmp_path, mesh):
     import os

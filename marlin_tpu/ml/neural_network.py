@@ -35,7 +35,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..mesh import ROWS, default_mesh
 
-__all__ = ["NeuralNetwork", "mlp_init", "mlp_forward", "mlp_loss", "train_step"]
+__all__ = ["NeuralNetwork", "mlp_init", "mlp_forward", "mlp_loss", "train_step",
+           "train_step_optax"]
 
 
 def mlp_init(key, layer_sizes: tuple[int, ...], dtype=jnp.float32) -> dict:
@@ -82,13 +83,8 @@ def mlp_loss(params: dict, x: jax.Array, y: jax.Array,
     return 0.5 * jnp.mean(jnp.sum((out - y) ** 2, axis=-1))
 
 
-@functools.partial(jax.jit, static_argnames=("batch_size", "lr", "remat", "activation"))
-def train_step(params, x, y, key, batch_size: int, lr: float, remat: bool = False,
-               activation: str = "sigmoid"):
-    """One SPMD step: strided batch sample + grad + SGD update. ``remat=True``
-    rematerializes the forward in the backward pass (``jax.checkpoint``) —
-    trading FLOPs for activation memory, the knob for models/batches near the
-    HBM limit."""
+def _sampled_loss_and_grads(params, x, y, key, batch_size, remat, activation):
+    """Shared core of both step variants: strided batch sample + grad."""
     m = x.shape[0]
     stride = max(1, m // batch_size)
     offset = jax.random.randint(key, (), 0, m)
@@ -99,9 +95,55 @@ def train_step(params, x, y, key, batch_size: int, lr: float, remat: bool = Fals
         return mlp_loss(p, xx, yy, activation)
 
     loss_fn = jax.checkpoint(loss_with_act) if remat else loss_with_act
-    loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+    return jax.value_and_grad(loss_fn)(params, xb, yb)
+
+
+@functools.partial(jax.jit, static_argnames=("batch_size", "lr", "remat", "activation"))
+def train_step(params, x, y, key, batch_size: int, lr: float, remat: bool = False,
+               activation: str = "sigmoid"):
+    """One SPMD step: strided batch sample + grad + SGD update (the
+    reference's plain update, examples/NeuralNetwork.scala:244-248).
+    ``remat=True`` rematerializes the forward in the backward pass
+    (``jax.checkpoint``) — trading FLOPs for activation memory, the knob for
+    models/batches near the HBM limit."""
+    loss, grads = _sampled_loss_and_grads(params, x, y, key, batch_size,
+                                          remat, activation)
     new_params = jax.tree.map(lambda w, g: w - lr * g, params, grads)
     return new_params, loss
+
+
+def _build_tx(optimizer: str, lr: float, momentum: float):
+    """optax transform from plain config values. Called both outside jit (for
+    ``tx.init``) and inside the jitted step — keying the step's static args on
+    ``(optimizer, lr, momentum)`` primitives means identical configs share one
+    compiled program, where a GradientTransformation object per instance would
+    retrace every time."""
+    import optax
+
+    if optimizer == "momentum":
+        return optax.sgd(lr, momentum=momentum)
+    if optimizer == "adam":
+        return optax.adam(lr)
+    raise ValueError(
+        f"unknown optimizer {optimizer!r} (one of 'sgd', 'momentum', 'adam')"
+    )
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "batch_size", "optimizer", "lr", "momentum", "remat", "activation"))
+def train_step_optax(params, opt_state, x, y, key, batch_size: int,
+                     optimizer: str, lr: float, momentum: float = 0.9,
+                     remat: bool = False, activation: str = "sigmoid"):
+    """The optimizer-parameterized step: optax momentum/adam instead of the
+    reference's plain SGD. Same sampling and grad core; the update rule is
+    the only difference."""
+    import optax
+
+    loss, grads = _sampled_loss_and_grads(params, x, y, key, batch_size,
+                                          remat, activation)
+    tx = _build_tx(optimizer, lr, momentum)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss
 
 
 @dataclasses.dataclass
@@ -118,6 +160,8 @@ class NeuralNetwork:
     seed: int = 0
     remat: bool = False  # jax.checkpoint the forward (memory for FLOPs)
     activation: str = "sigmoid"  # hidden activation; "relu" for deep stacks
+    optimizer: str = "sgd"  # "sgd" (reference parity) | "momentum" | "adam"
+    momentum: float = 0.9  # used by optimizer="momentum"
 
     @property
     def layer_sizes(self) -> tuple[int, ...]:
@@ -132,6 +176,7 @@ class NeuralNetwork:
         repl = NamedSharding(mesh, P())
         return jax.tree.map(lambda w: jax.device_put(w, repl), params)
 
+
     def train(
         self,
         data,
@@ -142,14 +187,23 @@ class NeuralNetwork:
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0,
         log_every: int = 0,
+        opt_state=None,
     ):
         """Train; ``data`` is a DenseVecMatrix/BlockMatrix (rows = examples),
         ``labels`` an (m,) int vector (DistributedIntVector/array) one-hot
         encoded internally, like the reference's label chunks
-        (examples/NeuralNetwork.scala:64-84). Returns (params, losses)."""
+        (examples/NeuralNetwork.scala:64-84). Returns (params, losses).
+
+        With a non-SGD ``optimizer``, mid-training checkpoints hold
+        ``{"params": ..., "opt_state": ...}`` (optimizer moments must survive
+        a restart — a resume that resets Adam's moments spikes the loss), the
+        final optimizer state is left on ``self.last_opt_state``, and
+        ``opt_state`` lets a resumed run pass it back in."""
         from ..io.checkpoint import save_checkpoint
         from ..matrix.vector import DistributedVector
 
+        if self.optimizer != "sgd":
+            _build_tx(self.optimizer, self.learning_rate, self.momentum)  # validate
         mesh = getattr(data, "mesh", None) or default_mesh()
         x = data.logical() if hasattr(data, "logical") else jnp.asarray(data)
         x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(ROWS, None)))
@@ -165,17 +219,31 @@ class NeuralNetwork:
         batch_size = min(batch_size, x.shape[0])
         losses = []
         key = jax.random.key(self.seed + 1)
+        use_optax = self.optimizer != "sgd"
+        if use_optax and opt_state is None:
+            opt_state = _build_tx(self.optimizer, self.learning_rate,
+                                  self.momentum).init(params)
         for it in range(iterations):
             key, sub = jax.random.split(key)
-            params, loss = train_step(
-                params, x, y, sub, batch_size, self.learning_rate, self.remat,
-                self.activation,
-            )
+            if not use_optax:
+                params, loss = train_step(
+                    params, x, y, sub, batch_size, self.learning_rate,
+                    self.remat, self.activation,
+                )
+            else:
+                params, opt_state, loss = train_step_optax(
+                    params, opt_state, x, y, sub, batch_size, self.optimizer,
+                    self.learning_rate, self.momentum, self.remat,
+                    self.activation,
+                )
             if log_every and (it + 1) % log_every == 0:
                 print(f"iter {it + 1}: loss {float(loss):.6f}")
             losses.append(loss)
             if checkpoint_dir and checkpoint_every and (it + 1) % checkpoint_every == 0:
-                save_checkpoint(params, checkpoint_dir, it + 1)
+                state = (params if not use_optax
+                         else {"params": params, "opt_state": opt_state})
+                save_checkpoint(state, checkpoint_dir, it + 1)
+        self.last_opt_state = opt_state
         return params, [float(l) for l in losses]
 
     def predict(self, params: dict, data) -> np.ndarray:

@@ -69,6 +69,16 @@ if MODE == "matmul":
     ref = np.asarray(attention_reference(jnp.asarray(q), jnp.asarray(k),
                                          jnp.asarray(v), causal=True))
     check_shards(out, ref)
+    # ulysses: the all_to_all head/sequence re-shard crosses the process
+    # boundary (4+4 devices over two OS processes)
+    from marlin_tpu.parallel.ulysses import ulysses_attention
+    hq, hk, hv = (rng.standard_normal((8, 19, 8)).astype(np.float32)
+                  for _ in range(3))
+    uout = ulysses_attention(jnp.asarray(hq), jnp.asarray(hk),
+                             jnp.asarray(hv), mesh=mesh, causal=True)
+    uref = np.asarray(attention_reference(jnp.asarray(hq), jnp.asarray(hk),
+                                          jnp.asarray(hv), causal=True))
+    check_shards(uout, uref)
     print(f"proc {proc_id}: global sum ok ({total:.4f})", flush=True)
 elif MODE == "save":
     # each process writes only its addressable shards (VERDICT r1 #6)

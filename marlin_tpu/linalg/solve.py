@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from .factorizations import PIVOT_STRATEGIES, _mode_to_local, lu_decompose
 

@@ -43,6 +43,7 @@ from .parallel import (  # noqa: F401
     ring_matmul,
     rmm_matmul,
     split_method,
+    tune_multiply,
     ulysses_attention,
 )
 from .linalg import cholesky_decompose, compute_svd, inverse, lanczos, lu_decompose  # noqa: F401

@@ -286,6 +286,12 @@ class DenseMatrix(DistributedMatrix):
             return self.multiply_vector(other)
         if hasattr(other, "ndim") and other.ndim == 1:
             return self.multiply_vector(DistributedVector.from_array(other, self.mesh))
+        if strategy == "tuned":
+            # empirical dispatch: time the viable engines once per
+            # configuration and use the cached winner (parallel.autotune)
+            from ..parallel.autotune import best_strategy
+
+            strategy = best_strategy(self, other, precision=precision)
 
         from ..parallel.matmul import matmul_padded
 

@@ -1,0 +1,133 @@
+"""Empirical multiply-strategy autotuning.
+
+The reference picks its multiply execution statically: a broadcast-size
+threshold plus the CARMA split heuristic (DenseVecMatrix.scala:196-231,
+MTUtils.scala:150-175), and ships ``RMMcompare`` (examples/RMMcompare.scala)
+so a human can time the candidates and pick by hand. This module makes that
+comparison programmatic: time each viable engine on the real operands ONCE
+per (shape, dtype, precision, mesh) configuration, cache the winner
+in-process, and let ``multiply(strategy="tuned")`` consult the cache — an
+empirical dispatch that beats any static heuristic wherever the heuristic's
+model of the machine is wrong (e.g. dispatch-latency-bound mid sizes, or
+meshes where resharding costs dominate).
+
+Timing discipline: dispatch is async (and the relay environment adds a fixed
+sync cost), so each candidate is compiled first, then ``reps`` calls are
+enqueued back-to-back and forced once with a scalar fetch — the same
+``MTUtils.evaluate`` discipline the benchmarks use.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+
+__all__ = ["tune_multiply", "best_strategy", "clear_cache"]
+
+_CACHE: dict[tuple, str] = {}
+
+
+def _operand_meta(other):
+    """(shape, dtype, spec) of the right operand — spec present only for
+    distributed matrices (a raw array has no layout of its own)."""
+    shape = getattr(other, "shape", None) or jnp.asarray(other).shape
+    dtype = getattr(getattr(other, "data", other), "dtype", jnp.float32)
+    spec = tuple(getattr(other, "spec", ()) or ())
+    return tuple(shape), dtype, spec
+
+
+def _cache_key(mat, other, precision):
+    """Layouts matter as much as shapes: a row-sharded and a block-sharded
+    pair of the same shape reshard differently per strategy, so both operands'
+    specs (and the matrix class) are part of the key."""
+    other_shape, other_dtype, other_spec = _operand_meta(other)
+    mesh = mat.mesh
+    return (
+        type(mat).__name__,
+        mat.shape,
+        tuple(mat.spec),
+        other_shape,
+        other_spec,
+        str(mat.data.dtype),
+        str(other_dtype),
+        precision,
+        tuple(sorted(mesh.shape.items())),
+        mesh.devices.flat[0].platform,
+    )
+
+
+def _candidates(mat, other_shape, other_itemsize) -> list[str]:
+    """Viable engines for this problem: always gspmd + rmm + ring; the two
+    broadcast forms only when the replicated operand is within 4x the
+    configured threshold (beyond that the replication alone disqualifies them
+    — no point timing a guaranteed loser). Each operand is sized with its OWN
+    itemsize."""
+    from ..config import get_config
+
+    m, k = mat.shape
+    n = other_shape[1]
+    a_itemsize = jnp.dtype(mat.data.dtype).itemsize
+    threshold = 4 * get_config().broadcast_threshold_mb
+    cands = ["gspmd", "rmm", "ring"]
+    if k * n * other_itemsize / 1e6 <= threshold:
+        cands.append("broadcast")
+    if m * k * a_itemsize / 1e6 <= threshold:
+        cands.append("broadcast_a")
+    return cands
+
+
+def tune_multiply(mat, other, strategies=None, reps: int = 3,
+                  precision: str | None = None) -> list[tuple[str, float]]:
+    """Time each candidate strategy for ``mat.multiply(other)`` on the live
+    mesh and return ``[(strategy, seconds_per_multiply), ...]`` sorted
+    fastest-first.
+
+    With the default (full) candidate set, the winner is cached so
+    ``strategy="tuned"`` multiplies of the same configuration dispatch
+    straight to it; an explicit ``strategies`` subset times those engines
+    only and does NOT touch the cache (a subset winner must never pin the
+    tuned dispatch)."""
+    from ..utils.profiling import evaluate
+
+    other_shape, other_dtype, _ = _operand_meta(other)
+    if mat.shape[1] != other_shape[0]:
+        raise ValueError(
+            f"inner dim mismatch: {mat.shape} @ {other_shape}"
+        )
+    explicit = strategies is not None
+    if not explicit:
+        strategies = _candidates(mat, other_shape,
+                                 jnp.dtype(other_dtype).itemsize)
+    results = []
+    for s in strategies:
+        try:
+            c = mat.multiply(other, strategy=s, precision=precision)  # compile
+            evaluate(c)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                c = mat.multiply(other, strategy=s, precision=precision)
+            evaluate(c)
+            results.append((s, (time.perf_counter() - t0) / reps))
+        except ValueError:
+            # unknown/unsupported strategy name for this configuration;
+            # genuine execution failures (OOM, runtime errors) propagate
+            continue
+    if not results:
+        raise ValueError("no viable multiply strategy could be timed")
+    results.sort(key=lambda kv: kv[1])
+    if not explicit:
+        _CACHE[_cache_key(mat, other, precision)] = results[0][0]
+    return results
+
+
+def best_strategy(mat, other, precision: str | None = None) -> str:
+    """Cached winner for this configuration — tunes on first sight."""
+    key = _cache_key(mat, other, precision)
+    if key not in _CACHE:
+        tune_multiply(mat, other, precision=precision)
+    return _CACHE[key]
+
+
+def clear_cache() -> None:
+    _CACHE.clear()

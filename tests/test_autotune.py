@@ -1,0 +1,87 @@
+"""Empirical multiply-strategy autotuner (programmatic RMMcompare)."""
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+from marlin_tpu.parallel import autotune
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    autotune.clear_cache()
+    yield
+    autotune.clear_cache()
+
+
+def test_tune_multiply_times_candidates(mesh):
+    a = mt.DenseVecMatrix.random(0, 64, 48, mesh=mesh)
+    b = mt.DenseVecMatrix.random(1, 48, 32, mesh=mesh)
+    results = mt.tune_multiply(a, b, reps=1)
+    assert len(results) >= 2
+    assert all(sec > 0 for _, sec in results)
+    # sorted fastest-first
+    secs = [sec for _, sec in results]
+    assert secs == sorted(secs)
+
+
+def test_tuned_strategy_matches_oracle(mesh):
+    a = mt.DenseVecMatrix.random(2, 40, 24, mesh=mesh)
+    b = mt.DenseVecMatrix.random(3, 24, 16, mesh=mesh)
+    c = a.multiply(b, strategy="tuned")
+    np.testing.assert_allclose(c.to_numpy(), a.to_numpy() @ b.to_numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_tuned_uses_cache(mesh):
+    a = mt.DenseVecMatrix.random(4, 32, 32, mesh=mesh)
+    b = mt.DenseVecMatrix.random(5, 32, 32, mesh=mesh)
+    a.multiply(b, strategy="tuned")
+    assert len(autotune._CACHE) == 1
+    calls = {"n": 0}
+    orig = autotune.tune_multiply
+
+    def spy(*args, **kw):
+        calls["n"] += 1
+        return orig(*args, **kw)
+
+    autotune.tune_multiply = spy
+    try:
+        a.multiply(b, strategy="tuned")  # same config -> no re-tune
+    finally:
+        autotune.tune_multiply = orig
+    assert calls["n"] == 0
+
+
+def test_explicit_strategy_list_does_not_pin_cache(mesh):
+    a = mt.DenseVecMatrix.random(6, 32, 32, mesh=mesh)
+    b = mt.DenseVecMatrix.random(7, 32, 32, mesh=mesh)
+    results = mt.tune_multiply(a, b, strategies=["gspmd", "broadcast"], reps=1)
+    assert {s for s, _ in results} <= {"gspmd", "broadcast"}
+    # a restricted benchmark must never pin strategy="tuned" dispatch
+    assert len(autotune._CACHE) == 0
+
+
+def test_no_viable_strategy_raises(mesh):
+    a = mt.DenseVecMatrix.random(8, 16, 16, mesh=mesh)
+    b = mt.DenseVecMatrix.random(9, 16, 16, mesh=mesh)
+    with pytest.raises(ValueError):
+        mt.tune_multiply(a, b, strategies=["not_a_strategy"])
+
+
+def test_shape_mismatch_error_is_clear(mesh):
+    a = mt.DenseVecMatrix.random(10, 64, 48, mesh=mesh)
+    b = mt.DenseVecMatrix.random(11, 32, 32, mesh=mesh)
+    with pytest.raises(ValueError, match="inner dim mismatch"):
+        mt.tune_multiply(a, b)
+
+
+def test_cache_keyed_on_layout(mesh):
+    # same shapes, different matrix classes/layouts -> distinct cache entries
+    a1 = mt.DenseVecMatrix.random(12, 32, 32, mesh=mesh)
+    b1 = mt.DenseVecMatrix.random(13, 32, 32, mesh=mesh)
+    a2 = mt.BlockMatrix.random(12, 32, 32, mesh=mesh)
+    b2 = mt.BlockMatrix.random(13, 32, 32, mesh=mesh)
+    a1.multiply(b1, strategy="tuned")
+    a2.multiply(b2, strategy="tuned")
+    assert len(autotune._CACHE) == 2

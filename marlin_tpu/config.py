@@ -45,6 +45,13 @@ class MarlinConfig:
     svd_local_dim: int = 2000
     # Lanczos iterations multiplier for dist-eigs SVD.
     lanczos_max_iter_factor: int = 10
+    # sparse x sparse: above this worst-case product count (nse_a * nse_b, the
+    # buffer XLA's BCOO spsp contraction allocates) the multiply routes to the
+    # host CSR kernel — the regime the reference always runs in (its CSC x CSC
+    # kernel is a per-block CPU routine, Matrices.scala:129-152). NOTE: the
+    # host path is eager-only; mult_sparse_sparse under jax.jit fails at trace
+    # time past this threshold.
+    spsp_device_max_products: int = 1 << 27
 
 
 _config = MarlinConfig()

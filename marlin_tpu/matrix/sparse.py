@@ -189,8 +189,7 @@ class SparseVecMatrix:
         """Sparse × sparse with sparse (COO) result — the role of the
         outer-product shuffle multiply (SparseVecMatrix.multiplySparse,
         SparseVecMatrix.scala:22-50), as one XLA sparse contraction."""
-        out = mult_sparse_sparse(self.bcoo, other.bcoo)
-        out = out.sum_duplicates()
+        out = mult_sparse_sparse(self.bcoo, other.bcoo)  # canonical result
         return CoordinateMatrix(out.indices[:, 0], out.indices[:, 1], out.data,
                                 shape=(self.num_rows(), other.num_cols()), mesh=self.mesh)
 

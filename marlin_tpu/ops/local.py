@@ -100,13 +100,58 @@ def mult_dense_sparse(dense: jax.Array, sp) -> jax.Array:
     return (_to_bcoo(sp).T @ dense.T).T
 
 
-def mult_sparse_sparse(a, b) -> jsparse.BCOO:
-    """Sparse × sparse multiply with sparse output (CSC×CSC in the reference,
-    Matrices.scala:129-152)."""
-    out = jsparse.bcoo_dot_general(
-        _to_bcoo(a), _to_bcoo(b), dimension_numbers=(((1,), (0,)), ((), ()))
+def _spsp_host(a: jsparse.BCOO, b: jsparse.BCOO) -> jsparse.BCOO:
+    """Host CSR×CSR for the large regime: the device BCOO contraction
+    allocates its worst-case nse_a × nse_b output buffer (every index pair
+    could collide), which is terabytes at 10⁶-nnz operands; the CSR merge
+    algorithm does O(flops) work — and a CPU sparse kernel is exactly the
+    regime the reference always runs (Matrices.scala:129-152)."""
+    import numpy as np
+    import scipy.sparse as sps
+
+    def to_csr(x):
+        # BCOO marks padding/masked entries with out-of-range indices (==
+        # dimension size) — e.g. the unmatched products of a prior device
+        # spsp contraction; scipy rejects them, so drop them first (their
+        # values are zero by construction). In-range duplicates are summed
+        # by scipy, matching BCOO's implicit-sum semantics.
+        rows = np.asarray(x.indices[:, 0])
+        cols = np.asarray(x.indices[:, 1])
+        vals = np.asarray(x.data)
+        keep = (rows < x.shape[0]) & (cols < x.shape[1])
+        if not keep.all():
+            rows, cols, vals = rows[keep], cols[keep], vals[keep]
+        return sps.csr_matrix((vals, (rows, cols)), shape=x.shape)
+
+    C = (to_csr(a) @ to_csr(b)).tocoo()
+    indices = jnp.stack(
+        [jnp.asarray(C.row, jnp.int32), jnp.asarray(C.col, jnp.int32)], axis=1
     )
-    return out
+    return jsparse.BCOO((jnp.asarray(C.data), indices),
+                        shape=(a.shape[0], b.shape[1]))
+
+
+def mult_sparse_sparse(a, b) -> jsparse.BCOO:
+    """Sparse × sparse multiply with canonical (deduplicated, in-range) sparse
+    output (CSC×CSC in the reference, Matrices.scala:129-152). Small problems
+    contract on device via BCOO; past ``config.spsp_device_max_products``
+    worst-case output products the multiply routes to the host CSR kernel
+    (see :func:`_spsp_host`).
+
+    The large regime is eager-only: the host path fetches the operand
+    triplets, so calling this inside ``jax.jit`` with operands past the
+    threshold raises a tracer-conversion error (the size cliff is static —
+    nse is a compile-time property — so the failure is at trace time, not
+    silently wrong)."""
+    a, b = _to_bcoo(a), _to_bcoo(b)
+    if a.nse * b.nse > get_config().spsp_device_max_products:
+        return _spsp_host(a, b)  # already canonical (scipy)
+    out = jsparse.bcoo_dot_general(
+        a, b, dimension_numbers=(((1,), (0,)), ((), ()))
+    )
+    # the device contraction emits worst-case nse with masked non-products;
+    # canonicalize here so both branches return the same shape of result
+    return out.sum_duplicates()
 
 
 def block_multiply(a: Any, b: Any, precision: str | None = None):

@@ -38,6 +38,52 @@ def test_sparse_times_sparse(mesh):
     np.testing.assert_allclose(out.to_numpy(), da @ db, rtol=1e-4, atol=1e-4)
 
 
+def test_sparse_times_sparse_large(mesh):
+    # scale leg for the sparse-output path (ROADMAP noted it unexercised
+    # beyond toy sizes): 100k x 100k operands, ~1M nnz each, ~10M-nnz
+    # product — checked against scipy on a sampled row block
+    import scipy.sparse as sps
+
+    m = k = n = 100_000
+    nnz = 1_000_000
+    rng = np.random.default_rng(0)
+    ra, ca = rng.integers(0, m, nnz), rng.integers(0, k, nnz)
+    rb, cb = rng.integers(0, k, nnz), rng.integers(0, n, nnz)
+    va = rng.random(nnz).astype(np.float32)
+    vb = rng.random(nnz).astype(np.float32)
+    spa = mt.CoordinateMatrix(ra, ca, va, (m, k), mesh=mesh).to_sparse_vec_matrix()
+    spb = mt.CoordinateMatrix(rb, cb, vb, (k, n), mesh=mesh).to_sparse_vec_matrix()
+    out = spa.multiply_sparse(spb)
+    assert isinstance(out, mt.CoordinateMatrix)
+    sa = sps.coo_matrix((va, (ra, ca)), (m, k)).tocsr()
+    sb = sps.coo_matrix((vb, (rb, cb)), (k, n)).tocsr()
+    ref = (sa @ sb).tocoo()
+    got = sps.coo_matrix(
+        (np.asarray(out.values),
+         (np.asarray(out.row_indices), np.asarray(out.col_indices))),
+        (m, n),
+    ).tocsr()
+    # compare a sampled row block exactly (full 10M-nnz comparison is slow)
+    rows = rng.integers(0, m, 200)
+    np.testing.assert_allclose(got[rows].toarray(), ref.tocsr()[rows].toarray(),
+                               rtol=1e-4, atol=1e-5)
+    assert got.nnz == ref.nnz
+
+
+def test_sparse_times_sparse_host_device_agree(mesh):
+    # both routing branches of mult_sparse_sparse must produce the same
+    # product: force the host path on a toy problem via the config threshold
+    # and compare against the device path
+    spa, da = _sp(mesh, 30, (12, 9))
+    spb, db = _sp(mesh, 31, (9, 11))
+    dev = spa.multiply_sparse(spb)
+    with mt.config_context(spsp_device_max_products=1):
+        host = spa.multiply_sparse(spb)
+    np.testing.assert_allclose(host.to_numpy(), dev.to_numpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(host.to_numpy(), da @ db, rtol=1e-4, atol=1e-5)
+
+
 def test_sparse_to_dense_vec(mesh):
     sp, dense = _sp(mesh, 5)
     dv = sp.to_dense_vec_matrix()

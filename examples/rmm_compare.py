@@ -13,7 +13,8 @@ from examples._common import die, millis
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) < 3:
-        die("usage: rmm_compare <A rows> <A cols> <B cols> [mode: rmm|gspmd|broadcast|all] [m k n]")
+        die("usage: rmm_compare <A rows> <A cols> <B cols> "
+            "[mode: rmm|gspmd|broadcast|all|tuned] [m k n]")
     rows, k, cols = (int(x) for x in argv[:3])
     mode = argv[3] if len(argv) > 3 else "all"
     split = tuple(int(x) for x in argv[4:7]) if len(argv) >= 7 else None
@@ -24,6 +25,15 @@ def main(argv=None):
     a = mt.BlockMatrix.random(0, rows, k, mesh=mesh)
     b = mt.BlockMatrix.random(1, k, cols, mesh=mesh)
     mt.evaluate(a, b)
+
+    if mode == "tuned":
+        # the programmatic form of this whole example: time every viable
+        # engine, cache the winner for strategy="tuned" dispatch
+        table = mt.tune_multiply(a, b)
+        for s, sec in table:
+            print(f"{s}: {sec * 1e3:.1f} millis")
+        print(f"fastest: {table[0][0]} ({table[0][1] * 1e3:.1f} millis)")
+        return dict((s, sec * 1e3) for s, sec in table)
 
     strategies = ["rmm", "gspmd", "broadcast"] if mode == "all" else [mode]
     timings = {}

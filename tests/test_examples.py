@@ -50,6 +50,14 @@ def test_blas3_cli(capsys):
     assert "local multiply" in out and "broadcast multiply" in out and "rmm multiply" in out
 
 
+def test_rmm_compare_tuned_mode(capsys):
+    from examples.rmm_compare import main
+
+    timings = main(["48", "32", "24", "tuned"])
+    out = capsys.readouterr().out
+    assert "fastest:" in out and len(timings) >= 2
+
+
 def test_rmm_compare_cli(capsys):
     from examples.rmm_compare import main
 

@@ -94,11 +94,14 @@ def devices_available(attempts: int | None = None) -> bool:
 
     if attempts is None:
         attempts = int(os.environ.get("MARLIN_BENCH_PROBE_ATTEMPTS", "2"))
-    # healthy init is seconds; the first timeout is set far above that so a
+    # healthy init is seconds; the first timeout is set FAR above that so a
     # probe kill at timeout almost certainly hits a genuinely wedged grant,
-    # not a healthy-but-slow one (killing a client mid-claim can wedge the
-    # relay — the failure this whole dance defends against)
-    timeouts = [float(os.environ.get("MARLIN_BENCH_PROBE_TIMEOUT", "240")),
+    # not a healthy-but-slow one. This matters more than bench latency:
+    # the timeout kill is a SIGKILL mid-claim, and killing a client that was
+    # merely starved (e.g. heavy CPU load alongside) is itself what wedges
+    # the relay — observed live in round 2. 480s costs 8 idle minutes in the
+    # wedged case; a false-positive kill costs hours of lease recovery.
+    timeouts = [float(os.environ.get("MARLIN_BENCH_PROBE_TIMEOUT", "480")),
                 360.0]
     backoffs = [60.0]
     last_err = "unknown"

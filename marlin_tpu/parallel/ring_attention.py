@@ -54,6 +54,32 @@ _KV_TILE = 2048  # inner tile bounding the (sq × tile) score buffer
 from ..ops.flash_attention import block_divisor as _block_divisor  # noqa: E402
 
 
+def softmax_tile_update(q_blk, k_t, v_t, m, l, acc, q_pos, k_pos, valid_len,
+                        causal: bool, scale: float):
+    """One blockwise-softmax step: fold the (q_blk × k_t) score tile into the
+    running (m, l, acc) state. The numerically delicate core shared by the
+    ring's XLA path and ulysses' recompute backward — fix masking/precision
+    here and both strategies get it."""
+    s = jnp.dot(q_blk, k_t.T, precision="highest",
+                preferred_element_type=jnp.float32) * scale
+    keep = k_pos[None, :] < valid_len
+    if causal:
+        keep = keep & (q_pos[:, None] >= k_pos[None, :])
+    s = jnp.where(keep, s, _NEG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    l = l * alpha + jnp.sum(p, axis=-1)
+    # p cast to v's dtype: f32 inputs keep the f32 "highest" path; bf16
+    # inputs (precision="default") run a native bf16 MXU matmul with f32
+    # accumulation — the flash kernel makes the same cast
+    acc = acc * alpha[:, None] + jnp.dot(
+        p.astype(v_t.dtype), v_t, precision="highest",
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l, acc
+
+
 @functools.lru_cache(maxsize=32)
 def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
                   flash: bool):
@@ -108,25 +134,9 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
             off = t * tile
             k_t = jax.lax.dynamic_slice(k_cur, (off, 0), (tile, d))
             v_t = jax.lax.dynamic_slice(v_cur, (off, 0), (tile, d))
-            s = jnp.dot(q_blk, k_t.T, precision="highest",
-                        preferred_element_type=jnp.float32) * scale
             k_pos = owner * skv + off + jnp.arange(tile)
-            keep = k_pos[None, :] < valid_len
-            if causal:
-                keep = keep & (q_pos[:, None] >= k_pos[None, :])
-            s = jnp.where(keep, s, _NEG)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-            alpha = jnp.exp(m - m_new)
-            p_ = jnp.exp(s - m_new[:, None])
-            l = l * alpha + jnp.sum(p_, axis=-1)
-            # p cast to v's dtype: f32 inputs keep the f32 "highest" path;
-            # bf16 inputs (precision="default") run a native bf16 MXU matmul
-            # with f32 accumulation — the flash kernel makes the same cast
-            acc = acc * alpha[:, None] + jnp.dot(
-                p_.astype(v_t.dtype), v_t, precision="highest",
-                preferred_element_type=jnp.float32,
-            )
-            return m_new, l, acc
+            return softmax_tile_update(q_blk, k_t, v_t, m, l, acc,
+                                       q_pos, k_pos, valid_len, causal, scale)
 
         def step(i, carry):
             k_cur, v_cur, m, l, acc = carry
@@ -148,20 +158,45 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
         )
         return (acc / jnp.maximum(l, 1e-30)[:, None]).astype(q_blk.dtype)
 
-    @jax.jit
-    def f(q, k, v, valid_len):
+    def shard_mapped(fn, check_vma):
         # check_vma off on the flash path: the pallas interpreter's block
         # slicing mixes varying and invariant operands, which the vma checker
         # rejects (the XLA path keeps full checking)
         return jax.shard_map(
-            local_flash if flash else local,
+            fn,
             mesh=mesh,
             in_specs=(P(axis, None), P(axis, None), P(axis, None), P()),
             out_specs=P(axis, None),
-            check_vma=not flash,
-        )(q, k, v, valid_len)
+            check_vma=check_vma,
+        )
 
-    return f
+    xla_call = shard_mapped(local, True)
+    if not flash:
+        return jax.jit(xla_call)
+
+    flash_call = shard_mapped(local_flash, False)
+
+    # The Pallas kernel has no VJP; training through flash attention gets a
+    # custom one: forward runs the flash kernel, backward recomputes through
+    # the differentiable tiled XLA formulation (the two compute the same
+    # exact softmax, so the XLA path's gradient IS the gradient of the flash
+    # output up to FP reassociation). Standard flash-backward recompute
+    # trade: no score tensors saved from the forward.
+    @jax.custom_vjp
+    def f(q, k, v, valid_len):
+        return flash_call(q, k, v, valid_len)
+
+    def f_fwd(q, k, v, valid_len):
+        return flash_call(q, k, v, valid_len), (q, k, v, valid_len)
+
+    def f_bwd(res, ct):
+        q, k, v, valid_len = res
+        _, vjp = jax.vjp(lambda qq, kk, vv: xla_call(qq, kk, vv, valid_len),
+                         q, k, v)
+        return (*vjp(ct), None)
+
+    f.defvjp(f_fwd, f_bwd)
+    return jax.jit(f)
 
 
 def ring_attention(

@@ -2,11 +2,19 @@
 
 The reference's "models" are workloads built from its matrix primitives
 (SURVEY.md §0): a 2-layer MLP on MNIST, logistic regression, PageRank, and
-ALS matrix factorization. They are implemented in :mod:`marlin_tpu.ml`; this
-package re-exports them under the conventional ``models`` name.
+ALS matrix factorization. They are implemented in :mod:`marlin_tpu.ml` and
+re-exported here; :mod:`.transformer` adds the long-context causal LM (no
+reference analog — the model form of the sequence-parallel attention the
+task's long-context mandate makes first-class).
 """
 
 from ..ml.als import ALSModel, als_run  # noqa: F401
 from ..ml.logistic_regression import LogisticRegressionModel, logistic_regression  # noqa: F401
 from ..ml.neural_network import NeuralNetwork, mlp_forward, mlp_init, train_step  # noqa: F401
 from ..ml.pagerank import build_transition_matrix, pagerank  # noqa: F401
+from .transformer import (  # noqa: F401
+    TransformerLM,
+    init_transformer,
+    lm_loss,
+    transformer_forward,
+)

@@ -130,6 +130,14 @@ def test_nn_cli(capsys):
     assert "train accuracy" in out
 
 
+def test_long_context_training_cli(capsys):
+    from examples.long_context_training import main
+
+    losses = main(["128", "6", "32", "4", "1"])
+    out = capsys.readouterr().out
+    assert "tok/s" in out and losses[-1] < losses[0]
+
+
 @pytest.mark.parametrize("strategy", ["ring", "ulysses"])
 def test_attention_cli(capsys, strategy):
     from examples.attention import main

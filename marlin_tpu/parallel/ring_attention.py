@@ -212,9 +212,10 @@ def ring_attention(
 ) -> jax.Array:
     """Exact attention with the sequence sharded over ``axis``.
 
-    ``q``/``k``/``v``: (seq, d), or (heads, seq, d) for multi-head (vmapped
-    over heads). Sequence lengths are padded to the ring size; padded key
-    positions are masked out of the softmax exactly.
+    ``q``/``k``/``v``: (seq, d), (heads, seq, d), or any leading batch dims
+    (..., seq, d) — leading axes fold into one vmapped axis. Sequence lengths
+    are padded to the ring size; padded key positions are masked out of the
+    softmax exactly.
 
     ``backend``: ``"flash"`` runs each panel through the Pallas flash kernel
     (score tiles stay in VMEM, causal blocks below the diagonal skipped);
@@ -229,8 +230,15 @@ def ring_attention(
     each other (the kernel is softmax/VPU-bound there, BENCHMARKS.md); the
     bf16 MXU advantage materializes at larger head dims where the matmuls
     dominate. Mirrors the ``precision`` knob of ``DenseVecMatrix.multiply``."""
-    if q.ndim not in (2, 3) or k.shape != q.shape or v.shape != q.shape:
+    if q.ndim < 2 or k.shape != q.shape or v.shape != q.shape:
         raise ValueError(f"q/k/v shape mismatch: {q.shape} {k.shape} {v.shape}")
+    if q.ndim > 3:
+        # fold (batch..., heads) into ONE vmapped axis and restore after
+        lead = q.shape[:-2]
+        q2, k2, v2 = (x.reshape(-1, *x.shape[-2:]) for x in (q, k, v))
+        out = ring_attention(q2, k2, v2, mesh, axis, causal, scale, backend,
+                             precision)
+        return out.reshape(*lead, *out.shape[-2:])
     if backend not in ("auto", "flash", "xla"):
         raise ValueError(f"unknown ring attention backend: {backend!r}")
     if precision not in ("high", "default"):

@@ -158,17 +158,25 @@ def ulysses_attention(
 ) -> jax.Array:
     """Exact multi-head attention with all-to-all head/sequence re-sharding.
 
-    ``q``/``k``/``v``: (heads, seq, d) with ``heads`` divisible by the mesh
-    axis size (the balance requirement of the head split). Sequence lengths
-    that don't divide the axis are padded and masked exactly, like
-    :func:`ring_attention`. ``precision`` as in :func:`ring_attention`
-    ("default" narrows the MXU operands to bf16, keeping f32 softmax stats).
+    ``q``/``k``/``v``: (heads, seq, d) — or any leading batch dims
+    (..., heads, seq, d), folded into one head axis — with the folded axis
+    divisible by the mesh axis size (the balance requirement of the head
+    split). Sequence lengths that don't divide the axis are padded and masked
+    exactly, like :func:`ring_attention`. ``precision`` as in
+    :func:`ring_attention` ("default" narrows the MXU operands to bf16,
+    keeping f32 softmax stats).
     """
-    if q.ndim != 3 or k.shape != q.shape or v.shape != q.shape:
+    if q.ndim < 3 or k.shape != q.shape or v.shape != q.shape:
         raise ValueError(
-            f"ulysses needs (heads, seq, d) q/k/v of one shape, got "
+            f"ulysses needs (..., heads, seq, d) q/k/v of one shape, got "
             f"{q.shape} {k.shape} {v.shape}"
         )
+    if q.ndim > 3:
+        lead = q.shape[:-2]
+        q2, k2, v2 = (x.reshape(-1, *x.shape[-2:]) for x in (q, k, v))
+        out = ulysses_attention(q2, k2, v2, mesh, axis, causal, scale,
+                                precision)
+        return out.reshape(*lead, *out.shape[-2:])
     if precision not in ("high", "default"):
         raise ValueError(f"unknown ulysses precision: {precision!r}")
     mesh = mesh or default_mesh()

@@ -114,7 +114,36 @@ def test_ulysses_grad_memory_bounded(mesh):
         a, b = int(m_.group(1)), int(m_.group(2))
         assert not (a == b and a >= seq), \
             f"full ({a},{b}) score tensor in the backward program"
-    q, k, v = _qkv(3, 32, 8, 5)  # 3 heads won't divide the 2-wide axis
+
+
+def test_ulysses_batched(mesh):
+    # (batch, heads, seq, d): leading dims fold into the head split
+    rng = np.random.default_rng(21)
+    q, k, v = (jnp.asarray(rng.standard_normal((3, 4, 40, 8)).astype(np.float32))
+               for _ in range(3))
+    out = ulysses_attention(q, k, v, mesh, causal=True)
+    assert out.shape == q.shape
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_ring_attention_batched(mesh):
+    from marlin_tpu.parallel.ring_attention import ring_attention
+
+    rng = np.random.default_rng(22)
+    q, k, v = (jnp.asarray(rng.standard_normal((2, 3, 40, 8)).astype(np.float32))
+               for _ in range(3))
+    out = ring_attention(q, k, v, mesh, causal=True)
+    assert out.shape == q.shape
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_ulysses_validation(mesh):
+    heads_bad = mesh.shape["rows"] + 1  # never divides the rows axis
+    q, k, v = _qkv(heads_bad, 32, 8, 5)
     with pytest.raises(ValueError):
         ulysses_attention(q, k, v, mesh)
     q2, k2, v2 = _qkv(2, 32, 8, 6)

@@ -138,6 +138,76 @@ def lm_train_step(params, opt_state, tokens, mesh, heads: int, attn: str,
     return optax.apply_updates(params, updates), opt_state, loss
 
 
+def _decode_step(params, x, caches, pos, heads: int):
+    """One cached decode position: ``x`` is the (d_model,) embedded token at
+    ``pos``; ``caches`` maps layer -> (k, v) of shape (max_len, heads, dh).
+    Attention reads the cache prefix via position masking (static shapes —
+    the scan-friendly decode form of the causal mask)."""
+    n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
+    new_caches = {}
+    for i in range(n_layers):
+        lp = params[f"l{i}"]
+        ck, cv = caches[f"l{i}"]
+        d = x.shape[-1]
+        dh = d // heads
+        h = _rmsnorm(x, lp["ln1"])
+        q = (h @ lp["wq"]).reshape(heads, dh)
+        k = (h @ lp["wk"]).reshape(heads, dh)
+        v = (h @ lp["wv"]).reshape(heads, dh)
+        ck = jax.lax.dynamic_update_index_in_dim(ck, k, pos, 0)
+        cv = jax.lax.dynamic_update_index_in_dim(cv, v, pos, 0)
+        s = jnp.einsum("hd,thd->ht", q, ck) / math.sqrt(dh)
+        live = jnp.arange(ck.shape[0]) <= pos
+        s = jnp.where(live[None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("ht,thd->hd", p, cv).reshape(d) @ lp["wo"]
+        x = x + o
+        h = _rmsnorm(x, lp["ln2"])
+        x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        new_caches[f"l{i}"] = (ck, cv)
+    x = _rmsnorm(x, params["ln_f"])
+    return x @ params["emb"].T, new_caches
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps",
+                                             "temperature"))
+def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
+                temperature: float = 0.0):
+    """KV-cached autoregressive decode: prefill the prompt, then sample
+    ``steps`` tokens (greedy at ``temperature=0``). One ``lax.scan`` over
+    positions — the whole generation is a single XLA program."""
+    vocab, d = params["emb"].shape
+    n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
+    dh = d // heads
+    caches = {f"l{i}": (jnp.zeros((max_len, heads, dh)),
+                        jnp.zeros((max_len, heads, dh)))
+              for i in range(n_layers)}
+    prompt = jnp.asarray(prompt, jnp.int32)
+    n_prompt = prompt.shape[0]
+    tokens0 = jnp.zeros((max_len,), jnp.int32).at[:n_prompt].set(prompt)
+
+    def step(carry, pos):
+        tokens, caches, key = carry
+        x = params["emb"][tokens[pos]]
+        logits, caches = _decode_step(params, x, caches, pos, heads)
+        key, sub = jax.random.split(key)
+        if temperature > 0.0:
+            nxt = jax.random.categorical(sub, logits / temperature)
+        else:
+            nxt = jnp.argmax(logits)
+        # within the prompt, the "next token" is the given one (prefill)
+        nxt = jnp.where(pos + 1 < n_prompt, tokens[pos + 1], nxt.astype(jnp.int32))
+        write_at = jnp.minimum(pos + 1, max_len - 1)
+        tokens = tokens.at[write_at].set(
+            jnp.where(pos + 1 < max_len, nxt, tokens[write_at]))
+        return (tokens, caches, key), None
+
+    total = min(n_prompt + steps - 1, max_len - 1)
+    (tokens, _, _), _ = jax.lax.scan(
+        step, (tokens0, caches, key), jnp.arange(total))
+    return tokens[: n_prompt + steps]
+
+
 @dataclasses.dataclass
 class TransformerLM:
     """Trainer facade in the style of :class:`marlin_tpu.ml.NeuralNetwork`."""

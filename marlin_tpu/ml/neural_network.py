@@ -120,6 +120,8 @@ def _build_tx(optimizer: str, lr: float, momentum: float):
     retrace every time."""
     import optax
 
+    if optimizer == "sgd":
+        return optax.sgd(lr)
     if optimizer == "momentum":
         return optax.sgd(lr, momentum=momentum)
     if optimizer == "adam":

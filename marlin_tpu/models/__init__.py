@@ -15,6 +15,7 @@ from ..ml.pagerank import build_transition_matrix, pagerank  # noqa: F401
 from .transformer import (  # noqa: F401
     TransformerLM,
     init_transformer,
+    lm_generate,
     lm_loss,
     transformer_forward,
 )

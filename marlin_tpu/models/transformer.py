@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["TransformerLM", "init_transformer", "transformer_forward",
-           "lm_loss", "lm_train_step", "synthetic_stream"]
+           "lm_loss", "lm_train_step", "lm_generate", "synthetic_stream"]
 
 
 def synthetic_stream(seq: int, vocab: int = 64, seed: int = 0,
@@ -179,11 +179,16 @@ def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
     vocab, d = params["emb"].shape
     n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
     dh = d // heads
-    caches = {f"l{i}": (jnp.zeros((max_len, heads, dh)),
-                        jnp.zeros((max_len, heads, dh)))
+    cdtype = params["emb"].dtype  # caches follow the params dtype (bf16-safe)
+    caches = {f"l{i}": (jnp.zeros((max_len, heads, dh), cdtype),
+                        jnp.zeros((max_len, heads, dh), cdtype))
               for i in range(n_layers)}
     prompt = jnp.asarray(prompt, jnp.int32)
     n_prompt = prompt.shape[0]
+    if n_prompt + steps > max_len:
+        raise ValueError(
+            f"prompt ({n_prompt}) + steps ({steps}) exceeds max_len "
+            f"({max_len}); raise max_len or shorten the request")
     tokens0 = jnp.zeros((max_len,), jnp.int32).at[:n_prompt].set(prompt)
 
     def step(carry, pos):
@@ -197,12 +202,10 @@ def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
             nxt = jnp.argmax(logits)
         # within the prompt, the "next token" is the given one (prefill)
         nxt = jnp.where(pos + 1 < n_prompt, tokens[pos + 1], nxt.astype(jnp.int32))
-        write_at = jnp.minimum(pos + 1, max_len - 1)
-        tokens = tokens.at[write_at].set(
-            jnp.where(pos + 1 < max_len, nxt, tokens[write_at]))
+        tokens = tokens.at[pos + 1].set(nxt)  # pos+1 <= total <= max_len-1
         return (tokens, caches, key), None
 
-    total = min(n_prompt + steps - 1, max_len - 1)
+    total = n_prompt + steps - 1
     (tokens, _, _), _ = jax.lax.scan(
         step, (tokens0, caches, key), jnp.arange(total))
     return tokens[: n_prompt + steps]

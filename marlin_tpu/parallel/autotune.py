@@ -91,6 +91,11 @@ def tune_multiply(mat, other, strategies=None, reps: int = 3,
     from ..utils.profiling import evaluate
 
     other_shape, other_dtype, _ = _operand_meta(other)
+    if len(other_shape) != 2:
+        raise ValueError(
+            f"tune_multiply needs a 2-D right operand, got shape {other_shape}"
+            " — matrix @ vector dispatch does not go through the tuner"
+        )
     if mat.shape[1] != other_shape[0]:
         raise ValueError(
             f"inner dim mismatch: {mat.shape} @ {other_shape}"
@@ -109,10 +114,13 @@ def tune_multiply(mat, other, strategies=None, reps: int = 3,
                 c = mat.multiply(other, strategy=s, precision=precision)
             evaluate(c)
             results.append((s, (time.perf_counter() - t0) / reps))
-        except ValueError:
-            # unknown/unsupported strategy name for this configuration;
-            # genuine execution failures (OOM, runtime errors) propagate
-            continue
+        except ValueError as e:
+            # only the engine's own "unknown matmul strategy" rejection is a
+            # skippable candidate; any other ValueError is a genuinely broken
+            # run (layout/shape validation inside an engine) and must surface
+            if "unknown matmul strategy" in str(e):
+                continue
+            raise
     if not results:
         raise ValueError("no viable multiply strategy could be timed")
     results.sort(key=lambda kv: kv[1])

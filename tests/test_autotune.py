@@ -85,3 +85,10 @@ def test_cache_keyed_on_layout(mesh):
     a1.multiply(b1, strategy="tuned")
     a2.multiply(b2, strategy="tuned")
     assert len(autotune._CACHE) == 2
+
+
+def test_vector_operand_rejected_clearly(mesh):
+    a = mt.DenseVecMatrix.random(20, 32, 32, mesh=mesh)
+    v = np.ones((32,), np.float32)
+    with pytest.raises(ValueError, match="2-D right operand"):
+        mt.tune_multiply(a, v)

@@ -32,7 +32,33 @@ def test_nn_trains(mesh, separable):
     assert nn.accuracy(params, data, y) > 0.9
 
 
-@pytest.mark.parametrize("optimizer,lr", [("momentum", 0.5), ("adam", 0.01)])
+def test_train_step_optax_sgd_direct(mesh, separable):
+    """The facade routes optimizer='sgd' to the plain step, so exercise the
+    optax 'sgd' branch through train_step_optax itself (ADVICE r2: this call
+    used to fail with a message claiming 'sgd' is accepted)."""
+    import jax
+    import numpy as np
+
+    from marlin_tpu.ml.neural_network import _build_tx, train_step_optax
+
+    x, y = separable
+    nn = NeuralNetwork(input_dim=10, hidden_dim=16, output_dim=2, seed=0)
+    params = nn.init_params(mesh, np.float32)
+    y1h = jax.nn.one_hot(y, 2, dtype=np.float32)
+    opt_state = _build_tx("sgd", 0.5, 0.9).init(params)
+    loss0 = None
+    key = jax.random.key(0)
+    for _ in range(20):
+        key, sub = jax.random.split(key)
+        params, opt_state, loss = train_step_optax(
+            params, opt_state, jax.numpy.asarray(x), y1h, sub,
+            batch_size=128, optimizer="sgd", lr=0.5)
+        loss0 = loss0 if loss0 is not None else float(loss)
+    assert float(loss) < loss0
+
+
+@pytest.mark.parametrize("optimizer,lr",
+                         [("sgd", 2.0), ("momentum", 0.5), ("adam", 0.01)])
 def test_nn_optimizers(mesh, separable, optimizer, lr):
     # the optax-backed steps must train at least as reliably as plain SGD
     x, y = separable

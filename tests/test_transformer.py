@@ -5,7 +5,7 @@ import pytest
 
 import jax.numpy as jnp
 
-from marlin_tpu.models import TransformerLM, lm_loss, transformer_forward
+from marlin_tpu.models import TransformerLM, lm_generate, lm_loss, transformer_forward
 from marlin_tpu.models.transformer import synthetic_stream as _tokens
 
 
@@ -60,3 +60,72 @@ def test_transformer_bad_attn(mesh):
     lm = TransformerLM(attn="dense")
     with pytest.raises(ValueError):
         lm.train(_tokens(33), steps=1, mesh=mesh)
+
+
+def test_lm_generate_matches_dense_oracle(mesh):
+    """Greedy KV-cached decode must equal argmax over the full (uncached)
+    forward recomputed at every position — the decode path's correctness
+    oracle."""
+    import jax
+
+    lm = TransformerLM(vocab=32, d_model=16, heads=2, layers=2, seed=3)
+    p = lm.init_params()
+    prompt = np.array([5, 1, 9, 2], np.int32)
+    steps = 6
+    out = np.asarray(lm_generate(p, prompt, jax.random.key(0), heads=2,
+                                 max_len=32, steps=steps))
+    assert out.shape == (len(prompt) + steps,)
+    assert out[: len(prompt)].tolist() == prompt.tolist(), "prefill must echo prompt"
+    cur = prompt.tolist()
+    for _ in range(steps):
+        logits = transformer_forward(p, np.array(cur, np.int32), mesh, heads=2)
+        cur.append(int(np.argmax(np.asarray(logits[-1]))))
+    assert out.tolist() == cur
+
+
+def test_lm_generate_sampled_and_edges(mesh):
+    import jax
+
+    lm = TransformerLM(vocab=16, d_model=16, heads=2, layers=1, seed=4)
+    p = lm.init_params()
+    out = np.asarray(lm_generate(p, np.array([3], np.int32), jax.random.key(1),
+                                 heads=2, max_len=12, steps=8, temperature=1.0))
+    assert out.shape == (9,) and np.all((out >= 0) & (out < 16))
+    # single-token prompt with steps filling max_len exactly is legal
+    full = np.asarray(lm_generate(p, np.array([3], np.int32), jax.random.key(1),
+                                  heads=2, max_len=9, steps=8))
+    assert full.shape == (9,)
+    # overflow is rejected at trace time with an actionable message
+    with pytest.raises(ValueError, match="max_len"):
+        lm_generate(p, np.arange(8, dtype=np.int32), jax.random.key(0),
+                    heads=2, max_len=10, steps=4)
+
+
+def test_lm_generate_bf16_params(mesh):
+    """Caches follow the params dtype (ADVICE r2): bf16 params must decode."""
+    import jax
+
+    lm = TransformerLM(vocab=16, d_model=16, heads=2, layers=1, seed=5)
+    p = lm.init_params(dtype=jnp.bfloat16)
+    out = np.asarray(lm_generate(p, np.array([1, 2], np.int32),
+                                 jax.random.key(0), heads=2, max_len=8, steps=4))
+    assert out.shape == (6,) and np.all((out >= 0) & (out < 16))
+
+
+def test_lm_generate_reproduces_trained_pattern(mesh):
+    """After training on a noise-free periodic stream, greedy decode from one
+    period must continue the period — the end-to-end train->generate loop."""
+    import jax
+
+    vocab, period, step = 32, 4, 3
+    toks = _tokens(256, vocab=vocab, period=period, step=step, noise=0.0)
+    lm = TransformerLM(vocab=vocab, d_model=32, heads=2, layers=1,
+                       learning_rate=1e-2, seed=6)
+    params, losses = lm.train(toks, steps=40, mesh=mesh)
+    assert losses[-1] < 0.1, f"pattern not learned: {losses[-5:]}"
+    prompt = toks[: 2 * period]
+    out = np.asarray(lm_generate(params, prompt, jax.random.key(0),
+                                 heads=2, max_len=64, steps=2 * period))
+    expect = _tokens(4 * period, vocab=vocab, period=period, step=step,
+                     noise=0.0)[: len(out)]
+    assert out.tolist() == expect.tolist()

@@ -48,9 +48,10 @@ class MarlinConfig:
     # sparse x sparse: above this worst-case product count (nse_a * nse_b, the
     # buffer XLA's BCOO spsp contraction allocates) the multiply routes to the
     # host CSR kernel — the regime the reference always runs in (its CSC x CSC
-    # kernel is a per-block CPU routine, Matrices.scala:129-152). NOTE: the
-    # host path is eager-only; mult_sparse_sparse under jax.jit fails at trace
-    # time past this threshold.
+    # kernel is a per-block CPU routine, Matrices.scala:129-152). Under
+    # jax.jit the host kernel runs through jax.pure_callback and needs a
+    # static out_nse bound (mult_sparse_sparse's kwarg); without one the
+    # trace fails with an error naming it.
     spsp_device_max_products: int = 1 << 27
 
 

@@ -8,4 +8,5 @@ from .text import (  # noqa: F401
     save_matrix,
 )
 from .checkpoint import save_checkpoint, load_checkpoint, save_sharded, load_sharded  # noqa: F401
+from .fs import register_filesystem  # noqa: F401
 from .orbax_ckpt import OrbaxCheckpointer  # noqa: F401

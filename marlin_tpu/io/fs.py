@@ -1,0 +1,111 @@
+"""Remote-filesystem hook for the matrix IO paths.
+
+The reference's loaders accept any Hadoop ``FileSystem`` URI — ``hdfs://``,
+``tachyon://``, ``file://`` — because Spark resolves the scheme for them
+(utils/MTUtils.scala:350-392 reads whole directories off HDFS). The rebuild's
+analog: a path with a URL scheme routes through ``fsspec`` (or any filesystem
+object registered for that scheme via :func:`register_filesystem`), while
+bare paths stay on the local-OS fast path — including the native C++ parser,
+which needs a real file descriptor.
+
+A "filesystem" here is anything with the small fsspec surface the loaders
+use: ``open(path, mode)``, ``ls(path)``, ``isdir(path)``, ``isfile(path)``,
+``makedirs(path, exist_ok=True)``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterator
+
+__all__ = ["register_filesystem", "get_filesystem", "split_scheme",
+           "open_path", "iter_lines", "make_parent_dirs"]
+
+_SCHEME = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
+_REGISTRY: dict[str, object] = {}
+
+
+def register_filesystem(scheme: str, fs) -> None:
+    """Route ``scheme://...`` paths through ``fs`` (fsspec-like). Overrides
+    the default fsspec resolution for that scheme; pass ``None`` to drop the
+    override."""
+    if fs is None:
+        _REGISTRY.pop(scheme, None)
+    else:
+        _REGISTRY[scheme] = fs
+
+
+def split_scheme(path: str) -> str | None:
+    """The URL scheme of ``path``, or None for a plain local path."""
+    m = _SCHEME.match(path)
+    return m.group(1) if m else None
+
+
+def get_filesystem(path: str):
+    """(fs, is_remote) for ``path``. Local paths return (None, False) so
+    callers can keep using plain ``open``/``os`` (and the native parser)."""
+    scheme = split_scheme(path)
+    if scheme is None or scheme == "file":
+        return None, False
+    if scheme in _REGISTRY:
+        return _REGISTRY[scheme], True
+    try:
+        import fsspec
+    except ImportError as e:
+        raise ValueError(
+            f"path {path!r} has scheme {scheme!r} but fsspec is not "
+            "available and no filesystem is registered for it — call "
+            "marlin_tpu.io.fs.register_filesystem"
+        ) from e
+    return fsspec.filesystem(scheme), True
+
+
+def open_path(path: str, mode: str = "r"):
+    """Open a local or remote path for reading/writing text."""
+    fs, remote = get_filesystem(path)
+    if not remote:
+        local = path[len("file://"):] if path.startswith("file://") else path
+        return open(local, mode)
+    return fs.open(path, mode)
+
+
+def iter_lines(path: str) -> Iterator[str]:
+    """Yield text lines from a file, or from every regular non-underscore
+    file of a directory (the reference's ``wholeTextFiles`` behavior,
+    MTUtils.scala:350-368) — local or remote."""
+    fs, remote = get_filesystem(path)
+    if not remote:
+        local = path[len("file://"):] if path.startswith("file://") else path
+        if os.path.isdir(local):
+            for name in sorted(os.listdir(local)):
+                full = os.path.join(local, name)
+                if os.path.isfile(full) and not name.startswith("_"):
+                    with open(full) as f:
+                        yield from f
+        else:
+            with open(local) as f:
+                yield from f
+        return
+    if fs.isdir(path):
+        listing = fs.ls(path, detail=False)
+        for full in sorted(str(p) for p in listing):
+            name = full.rsplit("/", 1)[-1]
+            if fs.isfile(full) and not name.startswith("_"):
+                with fs.open(full, "r") as f:
+                    yield from f
+    else:
+        with fs.open(path, "r") as f:
+            yield from f
+
+
+def make_parent_dirs(path: str) -> str:
+    """mkdir -p the parent of ``path`` (local or remote); returns the parent."""
+    fs, remote = get_filesystem(path)
+    if not remote:
+        parent = os.path.dirname(path) or "."
+        os.makedirs(parent, exist_ok=True)
+        return parent
+    parent = path.rsplit("/", 1)[0]
+    fs.makedirs(parent, exist_ok=True)
+    return parent

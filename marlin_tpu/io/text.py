@@ -17,6 +17,10 @@ Formats (all parsed with the reference's separator rule ``",\\s?|\\s+"``):
 
 Directory variants mirror the reference's ``wholeTextFiles`` loaders
 (MTUtils.scala:350-392): every regular file in the directory is concatenated.
+
+Paths may carry a URL scheme (``memory://``, ``s3://``, ``hdfs://`` — the
+reference's loaders take Hadoop FileSystem URIs); they route through the
+:mod:`marlin_tpu.io.fs` hook (fsspec by default).
 """
 
 from __future__ import annotations
@@ -26,6 +30,9 @@ import re
 
 import numpy as np
 
+from .fs import iter_lines as _iter_lines
+from .fs import make_parent_dirs, open_path, split_scheme
+
 _SEP = re.compile(r",\s?|\s+")
 
 
@@ -33,18 +40,6 @@ def _check_dims(shape, rows, cols):
     if rows is not None and cols is not None:
         return (rows, cols)
     return shape
-
-
-def _iter_lines(path: str):
-    if os.path.isdir(path):
-        for name in sorted(os.listdir(path)):
-            full = os.path.join(path, name)
-            if os.path.isfile(full) and not name.startswith("_"):
-                with open(full) as f:
-                    yield from f
-    else:
-        with open(path) as f:
-            yield from f
 
 
 def _rows_from_lines(lines):
@@ -71,7 +66,8 @@ def load_matrix_file(path: str, mesh=None):
     directories and fallback use the Python parser."""
     from ..matrix.dense import DenseVecMatrix
 
-    if os.path.isfile(path):
+    if split_scheme(path) is None and os.path.isfile(path):
+        # the native parser needs a real file descriptor — local only
         from .. import native
 
         arr = native.load_matrix_text(path)
@@ -195,12 +191,13 @@ def save_matrix(mat, path: str, fmt: str = "text", description: bool = False):
     BlockMatrix.save). ``description=True`` writes the ``_description`` sidecar
     (DenseVecMatrix.saveWithDescription)."""
     arr = mat.to_numpy()
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    remote = split_scheme(path) is not None
+    parent = make_parent_dirs(path)
     if fmt == "text":
         from .. import native
 
-        if not native.save_matrix_text(path, arr):
-            with open(path, "w") as f:
+        if remote or not native.save_matrix_text(path, arr):
+            with open_path(path, "w") as f:
                 for i in range(arr.shape[0]):
                     f.write(f"{i}:" + ",".join(repr(float(x)) for x in arr[i]) + "\n")
     elif fmt == "block":
@@ -211,7 +208,7 @@ def save_matrix(mat, path: str, fmt: str = "text", description: bool = False):
         nbc = mat.mesh.shape.get("cols", 1) if isinstance(mat, BlockMatrix) else 1
         m, n = arr.shape
         rsz, csz = -(-m // nbr), -(-n // nbc)
-        with open(path, "w") as f:
+        with open_path(path, "w") as f:
             for bi in range(nbr):
                 for bj in range(nbc):
                     blk = arr[bi * rsz : min((bi + 1) * rsz, m),
@@ -223,6 +220,7 @@ def save_matrix(mat, path: str, fmt: str = "text", description: bool = False):
     else:
         raise ValueError(f"unknown save format: {fmt}")
     if description:
-        with open(os.path.join(os.path.dirname(path) or ".", "_description"), "w") as f:
-            f.write(f"name: {os.path.basename(path)}\n")
+        sep = "/" if remote else os.sep
+        with open_path(f"{parent}{sep}_description", "w") as f:
+            f.write(f"name: {path.rsplit('/', 1)[-1] if remote else os.path.basename(path)}\n")
             f.write(f"rows: {arr.shape[0]}\ncols: {arr.shape[1]}\n")

@@ -185,11 +185,17 @@ class SparseVecMatrix:
             )
         return self._triplets
 
-    def multiply_sparse(self, other: "SparseVecMatrix") -> CoordinateMatrix:
+    def multiply_sparse(self, other: "SparseVecMatrix",
+                        out_nse: int | None = None) -> CoordinateMatrix:
         """Sparse × sparse with sparse (COO) result — the role of the
         outer-product shuffle multiply (SparseVecMatrix.multiplySparse,
-        SparseVecMatrix.scala:22-50), as one XLA sparse contraction."""
-        out = mult_sparse_sparse(self.bcoo, other.bcoo)  # canonical result
+        SparseVecMatrix.scala:22-50), as one XLA sparse contraction.
+
+        Under ``jax.jit`` the result size must be static, so the COO triplets
+        may carry padding entries (zero values, indices == shape); in the
+        large host-kernel regime pass ``out_nse`` (see
+        :func:`marlin_tpu.ops.local.mult_sparse_sparse`)."""
+        out = mult_sparse_sparse(self.bcoo, other.bcoo, out_nse=out_nse)
         return CoordinateMatrix(out.indices[:, 0], out.indices[:, 1], out.data,
                                 shape=(self.num_rows(), other.num_cols()), mesh=self.mesh)
 

@@ -100,57 +100,132 @@ def mult_dense_sparse(dense: jax.Array, sp) -> jax.Array:
     return (_to_bcoo(sp).T @ dense.T).T
 
 
+def _np_spsp(rows_a, cols_a, vals_a, shape_a, rows_b, cols_b, vals_b, shape_b):
+    """NumPy/scipy CSR×CSR core shared by the eager host route and the jit
+    pure_callback route. Returns the canonical COO triplet (row, col, val),
+    row-major sorted, duplicates summed."""
+    import numpy as np
+    import scipy.sparse as sps
+
+    def to_csr(rows, cols, vals, shape):
+        # BCOO marks padding/masked entries with out-of-range indices (==
+        # dimension size) — e.g. the unmatched products of a prior device
+        # spsp contraction; scipy rejects them, so drop them first (their
+        # values are zero by construction). In-range duplicates are summed
+        # by scipy, matching BCOO's implicit-sum semantics.
+        rows, cols, vals = np.asarray(rows), np.asarray(cols), np.asarray(vals)
+        keep = (rows < shape[0]) & (cols < shape[1])
+        if not keep.all():
+            rows, cols, vals = rows[keep], cols[keep], vals[keep]
+        return sps.csr_matrix((vals, (rows, cols)), shape=shape)
+
+    C = (to_csr(rows_a, cols_a, vals_a, shape_a)
+         @ to_csr(rows_b, cols_b, vals_b, shape_b)).tocoo()
+    return C.row, C.col, C.data
+
+
 def _spsp_host(a: jsparse.BCOO, b: jsparse.BCOO) -> jsparse.BCOO:
     """Host CSR×CSR for the large regime: the device BCOO contraction
     allocates its worst-case nse_a × nse_b output buffer (every index pair
     could collide), which is terabytes at 10⁶-nnz operands; the CSR merge
     algorithm does O(flops) work — and a CPU sparse kernel is exactly the
     regime the reference always runs (Matrices.scala:129-152)."""
-    import numpy as np
-    import scipy.sparse as sps
-
-    def to_csr(x):
-        # BCOO marks padding/masked entries with out-of-range indices (==
-        # dimension size) — e.g. the unmatched products of a prior device
-        # spsp contraction; scipy rejects them, so drop them first (their
-        # values are zero by construction). In-range duplicates are summed
-        # by scipy, matching BCOO's implicit-sum semantics.
-        rows = np.asarray(x.indices[:, 0])
-        cols = np.asarray(x.indices[:, 1])
-        vals = np.asarray(x.data)
-        keep = (rows < x.shape[0]) & (cols < x.shape[1])
-        if not keep.all():
-            rows, cols, vals = rows[keep], cols[keep], vals[keep]
-        return sps.csr_matrix((vals, (rows, cols)), shape=x.shape)
-
-    C = (to_csr(a) @ to_csr(b)).tocoo()
+    row, col, val = _np_spsp(a.indices[:, 0], a.indices[:, 1], a.data, a.shape,
+                             b.indices[:, 0], b.indices[:, 1], b.data, b.shape)
     indices = jnp.stack(
-        [jnp.asarray(C.row, jnp.int32), jnp.asarray(C.col, jnp.int32)], axis=1
+        [jnp.asarray(row, jnp.int32), jnp.asarray(col, jnp.int32)], axis=1
     )
-    return jsparse.BCOO((jnp.asarray(C.data), indices),
+    return jsparse.BCOO((jnp.asarray(val), indices),
                         shape=(a.shape[0], b.shape[1]))
 
 
-def mult_sparse_sparse(a, b) -> jsparse.BCOO:
+def _spsp_host_jit(a: jsparse.BCOO, b: jsparse.BCOO,
+                   out_nse: int) -> jsparse.BCOO:
+    """The host CSR route under tracing: ``jax.pure_callback`` with a static
+    ``out_nse`` result buffer. Entries past the true nnz are BCOO padding
+    (indices == shape, zero values); a result with nnz > out_nse raises from
+    the callback at run time rather than truncating silently."""
+    import numpy as np
+
+    m, n = a.shape[0], b.shape[1]
+    dtype = jnp.result_type(a.data.dtype, b.data.dtype)
+
+    def cb(ar, ac, av, br, bc, bv):
+        row, col, val = _np_spsp(ar, ac, av, a.shape, br, bc, bv, b.shape)
+        if len(val) > out_nse:
+            raise ValueError(
+                f"sparse x sparse result has {len(val)} nonzeros but "
+                f"out_nse={out_nse}; pass a larger out_nse to "
+                "mult_sparse_sparse"
+            )
+        out_val = np.zeros((out_nse,), dtype)
+        out_idx = np.full((out_nse, 2), (m, n), np.int32)  # BCOO padding
+        out_val[: len(val)] = val
+        out_idx[: len(val), 0] = row
+        out_idx[: len(val), 1] = col
+        return out_val, out_idx
+
+    val, idx = jax.pure_callback(
+        cb,
+        (jax.ShapeDtypeStruct((out_nse,), dtype),
+         jax.ShapeDtypeStruct((out_nse, 2), jnp.int32)),
+        a.indices[:, 0], a.indices[:, 1], a.data,
+        b.indices[:, 0], b.indices[:, 1], b.data,
+    )
+    return jsparse.BCOO((val, idx), shape=(m, n), unique_indices=True)
+
+
+def _is_tracing(*arrays) -> bool:
+    """True when any operand is a tracer OR we are inside a trace at all —
+    closed-over concrete operands still become tracers the moment an op
+    touches them, so the host route must go through pure_callback then too."""
+    if any(isinstance(x, jax.core.Tracer) for x in arrays):
+        return True
+    try:
+        from jax._src.core import trace_state_clean
+
+        return not trace_state_clean()
+    except (ImportError, AttributeError):
+        return False  # API moved; tracer operands were already checked
+
+
+def mult_sparse_sparse(a, b, out_nse: int | None = None) -> jsparse.BCOO:
     """Sparse × sparse multiply with canonical (deduplicated, in-range) sparse
     output (CSC×CSC in the reference, Matrices.scala:129-152). Small problems
     contract on device via BCOO; past ``config.spsp_device_max_products``
     worst-case output products the multiply routes to the host CSR kernel
-    (see :func:`_spsp_host`).
+    (see :func:`_spsp_host`) — the regime the reference always runs in.
 
-    The large regime is eager-only: the host path fetches the operand
-    triplets, so calling this inside ``jax.jit`` with operands past the
-    threshold raises a tracer-conversion error (the size cliff is static —
-    nse is a compile-time property — so the failure is at trace time, not
-    silently wrong)."""
+    Inside ``jax.jit`` both regimes work, with one data-size caveat: sparse
+    results need a static size under tracing, so the small regime pads its
+    result to the worst-case nse and the large regime runs the host kernel
+    through ``jax.pure_callback`` into an ``out_nse``-sized buffer (required
+    in that case; unused entries are BCOO padding, overflow raises at run
+    time). Eagerly the result is exact-sized and ``out_nse`` is ignored."""
     a, b = _to_bcoo(a), _to_bcoo(b)
+    tracing = _is_tracing(a.data, a.indices, b.data, b.indices)
     if a.nse * b.nse > get_config().spsp_device_max_products:
-        return _spsp_host(a, b)  # already canonical (scipy)
+        if not tracing:
+            return _spsp_host(a, b)  # already canonical (scipy)
+        if out_nse is None:
+            raise ValueError(
+                "mult_sparse_sparse under jit in the large regime "
+                f"(nse_a*nse_b = {a.nse * b.nse} > "
+                f"{get_config().spsp_device_max_products} = "
+                "config.spsp_device_max_products) runs the host CSR kernel "
+                "through jax.pure_callback, which needs a static result "
+                "size: pass out_nse=<upper bound on result nonzeros>"
+            )
+        return _spsp_host_jit(a, b, out_nse)
     out = jsparse.bcoo_dot_general(
         a, b, dimension_numbers=(((1,), (0,)), ((), ()))
     )
     # the device contraction emits worst-case nse with masked non-products;
-    # canonicalize here so both branches return the same shape of result
+    # canonicalize so both branches return a deduplicated result. Under
+    # tracing the deduplicated size must be static: keep the (already
+    # allocated) worst-case nse, extra slots become BCOO padding.
+    if tracing:
+        return out.sum_duplicates(nse=out.nse)
     return out.sum_duplicates()
 
 

@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 import marlin_tpu as mt
+from marlin_tpu.ops.local import mult_sparse_sparse
 
 
 def _sp(mesh, seed=0, shape=(12, 10), density=0.2):
@@ -111,3 +112,82 @@ def test_random_sparse(mesh):
     assert arr.shape == (50, 40)
     nnz_frac = (arr != 0).mean()
     assert 0.01 < nnz_frac < 0.1
+
+
+def test_sparse_times_sparse_inside_jit_small(mesh):
+    """The device branch must trace: static-nse canonicalization (the eager
+    result is exact-sized; the traced one may carry BCOO padding)."""
+    import jax
+
+    spa, da = _sp(mesh, 40, (12, 9))
+    spb, db = _sp(mesh, 41, (9, 11))
+    out = jax.jit(lambda a, b: mult_sparse_sparse(a, b))(spa.bcoo, spb.bcoo)
+    np.testing.assert_allclose(np.asarray(out.todense()), da @ db,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_times_sparse_inside_jit_large(mesh):
+    """The host-CSR branch under jit: 100k-square operands routed through
+    jax.pure_callback into a static out_nse buffer (VERDICT r2 #6)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+
+    m = 100_000
+    rng = np.random.default_rng(50)
+
+    def mk(seed, nnz=20_000):
+        r = np.random.default_rng(seed)
+        idx = np.stack([r.integers(0, m, nnz), r.integers(0, m, nnz)], 1)
+        return jsparse.BCOO(
+            (jnp.asarray(r.random(nnz, np.float32)), jnp.asarray(idx)),
+            shape=(m, m))
+
+    a, b = mk(1), mk(2)
+    assert a.nse * b.nse > mt.get_config().spsp_device_max_products
+    out = jax.jit(
+        lambda a, b: mult_sparse_sparse(a, b, out_nse=10_000))(a, b)
+    ref = mult_sparse_sparse(a, b)  # eager host kernel
+
+    def triplets(x):
+        idx, val = np.asarray(x.indices), np.asarray(x.data)
+        keep = (idx[:, 0] < m) & (idx[:, 1] < m) & (val != 0)
+        order = np.lexsort((idx[keep][:, 1], idx[keep][:, 0]))
+        return idx[keep][order], val[keep][order]
+
+    oi, ov = triplets(out)
+    ri, rv = triplets(ref)
+    np.testing.assert_array_equal(oi, ri)
+    np.testing.assert_allclose(ov, rv, rtol=1e-5)
+
+    # without out_nse the trace-time error names the fix
+    with pytest.raises(ValueError, match="out_nse"):
+        jax.jit(lambda a, b: mult_sparse_sparse(a, b))(a, b)
+
+    # an undersized buffer errors at run time instead of truncating
+    with pytest.raises(Exception, match="nonzeros"):
+        r = jax.jit(lambda a, b: mult_sparse_sparse(a, b, out_nse=3))(a, b)
+        jax.block_until_ready(r.data)
+
+
+def test_multiply_sparse_out_nse_kwarg(mesh):
+    """matrix-level API threads out_nse through to the host kernel."""
+    import jax
+
+    spa, da = _sp(mesh, 42, (12, 9))
+    spb, db = _sp(mesh, 43, (9, 11))
+    with mt.config_context(spsp_device_max_products=1):
+        # matrix classes are not jit arguments/outputs; close over the inputs
+        # and return triplets — the body still traces, so the host kernel
+        # runs through pure_callback
+        @jax.jit
+        def run():
+            out = spa.multiply_sparse(spb, out_nse=150)
+            return out.row_indices, out.col_indices, out.values
+
+        rows, cols, vals = run()
+    dense = np.zeros((12, 11), np.float32)
+    keep = (np.asarray(rows) < 12) & (np.asarray(cols) < 11)
+    np.add.at(dense, (np.asarray(rows)[keep], np.asarray(cols)[keep]),
+              np.asarray(vals)[keep])
+    np.testing.assert_allclose(dense, da @ db, rtol=1e-4, atol=1e-5)

@@ -22,6 +22,8 @@ the BASELINE config list:
   svd: top-8 SVD of 10^6 x 512 via the dist-eigs Gramian+Lanczos path
   nn: MLP training steps/s, 262k x 784 synthetic MNIST-shaped, batch 8192
   lct: long-context LM training tokens/s, 32k-token causal stream
+  lct_long: the longest-sequence training run one chip holds (256k+ tokens,
+       remat + chunked LM head; MARLIN_BENCH_LCT_SEQ scales it)
 """
 
 import json
@@ -335,7 +337,8 @@ def config_nn(m=262_144, d=784, hidden=1024, classes=10, batch=8192,
            f"loss {losses[-1]:.4f}")
 
 
-def config_lct(seq=32768, d_model=256, heads=2, layers=2, steps=3):
+def config_lct(seq=32768, d_model=256, heads=2, layers=2, steps=3,
+               remat=False, loss_chunk=None, name=None):
     """Long-context LM training throughput: one 32k-token causal stream,
     flash ring attention (dh=128 -> MXU tiles), Adam, full backward through
     the sequence-parallel attention (recompute VJP). No reference analog —
@@ -350,16 +353,31 @@ def config_lct(seq=32768, d_model=256, heads=2, layers=2, steps=3):
     vocab = 512
     tokens = rng.integers(0, vocab, seq).astype(np.int32)
     lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
-                       layers=layers, attn="ring")
+                       layers=layers, attn="ring", remat=remat,
+                       loss_chunk=loss_chunk)
     params, _ = lm.train(tokens, steps=1, mesh=mesh)  # compile
     t0 = time.perf_counter()
     params, losses = lm.train(tokens, steps=steps, mesh=mesh, params=params)
     dt = time.perf_counter() - t0
     assert np.isfinite(losses[-1])
-    record(f"lct_{seq}tok_d{d_model}_h{heads}_l{layers}",
+    knobs = "+remat" if remat else ""
+    knobs += f"+loss_chunk{loss_chunk}" if loss_chunk else ""
+    record(name or f"lct_{seq}tok_d{d_model}_h{heads}_l{layers}",
            seq * steps / dt / 1e3, "ktok/s",
            f"{steps} steps in {dt:.1f} s, loss {losses[-1]:.3f}, "
-           f"fwd+bwd through flash ring attention")
+           f"fwd+bwd through flash ring attention{knobs}")
+
+
+def config_lct_long():
+    """The marquee long-context run: the longest causal stream one 16 GB v5e
+    trains end-to-end (ring flash attention + per-block remat + chunked LM
+    head). HBM budget at the defaults (seq S=256k, d=256, L=2, f32):
+    residual checkpoints ~L*S*d*4 = 512 MB, block recompute peak ~S*d_ff*4
+    = 1 GB, head chunk ~MBs, params+Adam ~MBs — see docs/parallelism.md.
+    MARLIN_BENCH_LCT_SEQ scales it up (524288, 1048576) to find the cliff."""
+    seq = int(os.environ.get("MARLIN_BENCH_LCT_SEQ", 262144))
+    config_lct(seq=seq, steps=2, remat=True, loss_chunk=16384,
+               name=f"lct_long_{seq}tok_d256_h2_l2")
 
 
 def config_svd(m=1_000_000, n=512, k=8):
@@ -479,6 +497,7 @@ def main():
         "svd": config_svd,
         "nn": config_nn,
         "lct": config_lct,
+        "lct_long": config_lct_long,
     }
     for k in which:
         log(f"=== config {k}")

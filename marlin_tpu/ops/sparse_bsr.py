@@ -172,8 +172,42 @@ def _bsr_spmm_chunked(blocks, brows, bcols, b_panels, n_block_rows: int,
     return out[:n_block_rows]
 
 
-def _bsr_pallas_kernel(brows, bcols, blk_ref, b_ref, o_ref):
+def _bsr_pallas_kernel(brows, bcols, copy_of, slot_of, blk_ref, b_hbm, o_ref,
+                       b_buf, sem):
+    """Per stored block: one (bs×bs)@(bs×pp) MXU matmul into the resident
+    output tile, with the B panel double-buffered by hand.
+
+    The first formulation of this kernel selected the B panel with a
+    scalar-prefetched *input index map* (``lambda j, br, bc: (bc[j], 0, 0)``).
+    Mosaic cannot look ahead through a data-dependent map, so every panel
+    copy serialized against the previous step's compute — measured 10-30×
+    slower than the chunked XLA path (40-54 GFLOP/s; ROADMAP round-2 note).
+    Here the panel lives in HBM (``pl.ANY``) and the kernel itself starts the
+    DMA for step j+1's panel before waiting on step j's: the copy engine runs
+    ahead of the MXU again, which is exactly what Mosaic's automatic
+    pipelining would have done had the index been static."""
     j = pl.program_id(0)
+    nnzb = pl.num_programs(0)
+    # consecutive blocks sharing a column reuse the resident panel: slot_of[j]
+    # is the parity of distinct-panel copies up to j (precomputed host-side),
+    # copy_of[j] == 0 marks "same column as j-1, no DMA". This keeps the
+    # skip-copy behavior Mosaic's index-map pipelining would have given.
+    slot = slot_of[j]
+
+    def panel_dma(s, idx):
+        return pltpu.make_async_copy(b_hbm.at[bcols[idx]], b_buf.at[s],
+                                     sem.at[s])
+
+    @pl.when(j == 0)
+    def _warmup():
+        panel_dma(0, 0).start()
+
+    @pl.when((j + 1 < nnzb) & (copy_of[jnp.minimum(j + 1, nnzb - 1)] == 1))
+    def _prefetch_next():
+        # the slot being overwritten held the panel last read two copies ago;
+        # its final reader was an earlier (sequential) grid step
+        panel_dma(slot_of[jnp.minimum(j + 1, nnzb - 1)], j + 1).start()
+
     # output block index is brows[j] (scalar-prefetch-driven index map): while
     # consecutive programs hit the same block row, the output tile stays
     # resident in VMEM and accumulates — no scatter anywhere. Initialize on
@@ -184,25 +218,22 @@ def _bsr_pallas_kernel(brows, bcols, blk_ref, b_ref, o_ref):
     def _init():
         o_ref[:] = jnp.zeros_like(o_ref)
 
+    @pl.when(copy_of[j] == 1)
+    def _await_panel():
+        panel_dma(slot, j).wait()
+
     o_ref[:] += jnp.dot(
-        blk_ref[0], b_ref[0], preferred_element_type=jnp.float32
+        blk_ref[0], b_buf[slot], preferred_element_type=jnp.float32
     )[None]
 
 
 def bsr_spmm_pallas(bsr: BsrMatrix, b, interpret: bool | None = None) -> jax.Array:
-    """``bsr @ b`` as one Pallas pass: grid over stored blocks, B panels and
-    output tiles selected by scalar-prefetched block indices, accumulation in
-    VMEM. Versus :func:`bsr_spmm` this removes the block-row scatter-reduce
-    and the (chunk, bs, p) gather materialization entirely — each stored
-    block is one (bs×bs)@(bs×p) MXU matmul straight into the resident output
-    tile.
-
-    Measured on a v5e chip this formulation LOSES to :func:`bsr_spmm` by
-    10-30× (40-54 vs 580-1180 GFLOP/s across runs): the data-dependent index
-    maps defeat Mosaic's automatic DMA pipelining, serializing the per-step
-    panel copies (see ROADMAP.md).
-    It is kept as an opt-in reference implementation; ``backend="chunked"``
-    is the default for good reason."""
+    """``bsr @ b`` as one Pallas pass: grid over stored blocks, output tiles
+    selected by scalar-prefetched block-row indices and accumulated in VMEM,
+    B panels double-buffered into VMEM by explicit ``make_async_copy`` (see
+    :func:`_bsr_pallas_kernel` for why manual DMA). Versus :func:`bsr_spmm`
+    this removes the block-row scatter-reduce and the (chunk, bs, p) gather
+    materialization entirely."""
     b = jnp.asarray(b.logical() if hasattr(b, "logical") else b)
     m, n = bsr.shape
     if b.shape[0] != n:
@@ -231,20 +262,35 @@ def bsr_spmm_pallas(bsr: BsrMatrix, b, interpret: bool | None = None) -> jax.Arr
     blocks = bsr.blocks
     nnzb = bsr.nnzb
     f32 = jnp.float32
+    # copy_of[j]=1 where step j needs a fresh panel DMA (column differs from
+    # j-1); slot_of[j] = parity of copies so far = the double-buffer slot
+    # holding step j's panel. O(nnzb) int32 work, scalar-prefetched.
+    copy_of = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32),
+         (bcols[1:] != bcols[:-1]).astype(jnp.int32)])
+    slot_of = (jnp.cumsum(copy_of) - 1) % 2
     out = pl.pallas_call(
         _bsr_pallas_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=4,
             grid=(nnzb,),
             in_specs=[
-                pl.BlockSpec((1, bs, bs), lambda j, br, bc: (j, 0, 0)),
-                pl.BlockSpec((1, bs, pp), lambda j, br, bc: (bc[j], 0, 0)),
+                pl.BlockSpec((1, bs, bs), lambda j, *_: (j, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),  # panels stay in HBM
             ],
-            out_specs=pl.BlockSpec((1, bs, pp), lambda j, br, bc: (br[j], 0, 0)),
+            out_specs=pl.BlockSpec((1, bs, pp),
+                                   lambda j, br, *_: (br[j], 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, bs, pp), f32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
         ),
         out_shape=jax.ShapeDtypeStruct((n_block_rows, bs, pp), f32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
         interpret=interpret,
-    )(brows, bcols, blocks.astype(f32), b_panels.astype(f32))
+    )(brows, bcols, copy_of, slot_of.astype(jnp.int32),
+      blocks.astype(f32), b_panels.astype(f32))
     # block rows with no stored blocks are never visited -> undefined; mask
     has_blocks = jnp.zeros((n_block_rows,), bool).at[brows].set(
         True, indices_are_sorted=True)

@@ -172,3 +172,23 @@ def test_bsr_pallas_f64_routes_to_chunked():
     b = rng.standard_normal((64, 8))
     out = bsr_spmm_pallas(bsr, b)  # wider-than-f32 inputs: chunked fallback
     np.testing.assert_allclose(np.asarray(out), a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_bsr_pallas_repeated_column_skips_copy():
+    """A hot block column hit by every block row: consecutive stored blocks
+    share bcols, so the kernel's copy_of/slot_of bookkeeping (DMA skipped,
+    panel reused from the resident slot) is the path under test."""
+    from marlin_tpu.ops.sparse_bsr import BsrMatrix, bsr_spmm_pallas
+
+    rng = np.random.default_rng(7)
+    bs, nbr = 8, 5
+    # every row has a block in column 1; rows 1 and 3 also in columns 0/2
+    br = [0, 1, 1, 2, 3, 3, 4]
+    bc = [1, 0, 1, 1, 1, 2, 1]
+    blocks = rng.standard_normal((len(br), bs, bs)).astype(np.float32)
+    bsr = BsrMatrix(jnp.asarray(blocks), jnp.asarray(br, jnp.int32),
+                    jnp.asarray(bc, jnp.int32), (nbr * bs, 3 * bs), bs)
+    dense = np.asarray(bsr.to_dense())
+    b = rng.standard_normal((3 * bs, 11)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(bsr_spmm_pallas(bsr, b)), dense @ b,
+                               rtol=2e-4, atol=2e-4)

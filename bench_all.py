@@ -9,7 +9,7 @@ named (``python bench_all.py lu chol attn``) because they are additions beyond
 the BASELINE config list:
   1. 100×100 file-based multiply (genmat data), CPU-comparable
   2. 4000×4000 dense multiply, single chip
-  3. 20000×20000 dense multiply
+  3. 20000×20000 dense multiply (bf16: same multiply, bf16 MXU operands)
   4. tall-skinny ×512 Gramian, host-streamed (out-of-core)
   5. sparse 10⁶×10⁶ @ 1e-4 density × dense 10⁶×256 (ELL SpMM)
   lu / chol: 8192² distributed blocked factorizations
@@ -81,7 +81,7 @@ def config1():
     record("1_file_100x100", dt * 1e3, "ms", "file-loaded multiply incl. sync")
 
 
-def _dense_config(n, reps, name):
+def _dense_config(n, reps, name, precision="high"):
     import jax.numpy as jnp
 
     import marlin_tpu as mt
@@ -90,14 +90,15 @@ def _dense_config(n, reps, name):
     a = mt.DenseVecMatrix.random(0, n, n, mesh=mesh)
     b = mt.DenseVecMatrix.random(1, n, n, mesh=mesh)
     float(jnp.sum(a.data) + jnp.sum(b.data))
-    c = a.multiply(b, precision="high")
+    c = a.multiply(b, precision=precision)
     float(jnp.sum(c.data))
     t0 = time.perf_counter()
     for _ in range(reps):
-        c = a.multiply(b, precision="high")
+        c = a.multiply(b, precision=precision)
     float(jnp.sum(c.data))
     dt = (time.perf_counter() - t0) / reps
-    record(name, 2 * n**3 / dt / 1e9, "GFLOP/s", f"{dt * 1e3:.1f} ms/multiply")
+    record(name, 2 * n**3 / dt / 1e9, "GFLOP/s",
+           f"{dt * 1e3:.1f} ms/multiply, precision={precision}")
 
 
 def config4():
@@ -485,6 +486,10 @@ def main():
         # amortizes out of the per-multiply figure
         "2": lambda: _dense_config(4000, 100, "2_dense_4000"),
         "3": lambda: _dense_config(20000, 5, "3_dense_20000"),
+        # the bf16-storage speed story (accuracy story lives in `acc`):
+        # same 20000^2 multiply with bf16 MXU operands
+        "bf16": lambda: _dense_config(20000, 10, "3_dense_20000_bf16",
+                                      precision="default"),
         "4": config4,
         "5": config5,
         "lu": config_lu,

@@ -24,6 +24,8 @@ the BASELINE config list:
   lct: long-context LM training tokens/s, 32k-token causal stream
   lct_long: the longest-sequence training run one chip holds (256k+ tokens,
        remat + chunked LM head; MARLIN_BENCH_LCT_SEQ scales it)
+  attn_long: pure causal flash attention at 256k+ tokens
+       (MARLIN_BENCH_ATTN_SEQ scales it)
 """
 
 import json
@@ -231,7 +233,7 @@ def config_cholesky(n=8192):
                "GFLOP/s", f"{dt:.2f} s")
 
 
-def config_attention(seq=32768, d=128):
+def config_attention(seq=32768, d=128, variants=None, reps=10):
     import jax.numpy as jnp
 
     import marlin_tpu as mt
@@ -241,9 +243,9 @@ def config_attention(seq=32768, d=128):
     q, k, v = (jnp.asarray(rng.standard_normal((seq, d)).astype(np.float32))
                for _ in range(3))
     flops = 2.0 * seq * seq * d  # causal: qk^T + pv, halved by the mask
-    reps = 10  # amortize the relay's ~60 ms sync round-trip out of the figure
-    for backend, prec in (("xla", "high"), ("flash", "high"),
-                          ("flash", "default")):
+    # reps amortize the relay's ~60 ms sync round-trip out of the figure
+    for backend, prec in variants or (("xla", "high"), ("flash", "high"),
+                                      ("flash", "default")):
         out = mt.ring_attention(q, k, v, mesh, causal=True, backend=backend,
                                 precision=prec)
         float(jnp.sum(out))
@@ -367,6 +369,17 @@ def config_lct(seq=32768, d_model=256, heads=2, layers=2, steps=3,
            seq * steps / dt / 1e3, "ktok/s",
            f"{steps} steps in {dt:.1f} s, loss {losses[-1]:.3f}, "
            f"fwd+bwd through flash ring attention{knobs}")
+
+
+def config_attn_long():
+    """Pure-attention long-context point: one causal flash forward at 256k+
+    tokens (MARLIN_BENCH_ATTN_SEQ scales; O(S²) compute so reps stay low)."""
+    seq = int(os.environ.get("MARLIN_BENCH_ATTN_SEQ", 262144))
+    # reps amortize a ~60 ms relay sync; once a single forward is seconds
+    # (O(S²)) that amortization buys nothing — drop to 1 rep past 256k
+    config_attention(seq=seq, variants=(("flash", "high"),
+                                        ("flash", "default")),
+                     reps=3 if seq <= 262144 else 1)
 
 
 def config_lct_long():
@@ -503,6 +516,7 @@ def main():
         "nn": config_nn,
         "lct": config_lct,
         "lct_long": config_lct_long,
+        "attn_long": config_attn_long,
     }
     for k in which:
         log(f"=== config {k}")

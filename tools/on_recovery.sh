@@ -19,14 +19,15 @@ exec >>"$LOG" 2>&1
 
 ts() { date -u +%H:%M:%S; }
 
-# never overlap another client: wait for any in-flight probe OR bench
-# process (a wedged-relay bench from earlier may still be blocked in init)
-while pgrep -f "import jax|bench\.py|bench_all\.py" >/dev/null 2>&1; do
-  echo "$(ts) waiting for in-flight TPU client to exit"
-  sleep 60
-done
-
 while true; do
+  # never overlap another client: wait for any in-flight probe OR bench
+  # process (a wedged-relay bench from earlier may still be blocked in init).
+  # pytest is included not as a client but as CPU load: a starved backend
+  # init that then gets killed is the documented round-2 wedge cause.
+  while pgrep -f "import jax|bench\.py|bench_all\.py|pytest" >/dev/null 2>&1; do
+    echo "$(ts) waiting for in-flight TPU client / heavy CPU load to exit"
+    sleep 60
+  done
   echo "$(ts) probing"
   out=$(python -c "import jax; d = jax.devices(); print('NDEV', len(d), d[0].platform)" 2>&1 | tail -1)
   echo "$(ts) probe: $out"
@@ -50,12 +51,14 @@ echo "$(ts) [2/5] bench_all: previously-run shapes (fresh numbers)"
 python bench_all.py 3 bf16 lu chol lct nn
 
 echo "$(ts) [3/5] bench_all: new configs (riskier, after the safe ones)"
-python bench_all.py lct_long bsr 4
+python bench_all.py lct_long attn_long bsr 4
 
-echo "$(ts) [4/5] lct_long escalation: 512k"
-MARLIN_BENCH_LCT_SEQ=524288 python bench_all.py lct_long
+echo "$(ts) [4/5] long-context escalation: 512k"
+MARLIN_BENCH_LCT_SEQ=524288 MARLIN_BENCH_ATTN_SEQ=524288 \
+  python bench_all.py lct_long attn_long
 
-echo "$(ts) [5/5] lct_long escalation: 1M"
-MARLIN_BENCH_LCT_SEQ=1048576 python bench_all.py lct_long
+echo "$(ts) [5/5] long-context escalation: 1M"
+MARLIN_BENCH_LCT_SEQ=1048576 MARLIN_BENCH_ATTN_SEQ=1048576 \
+  python bench_all.py lct_long attn_long
 
 echo "$(ts) batch done"

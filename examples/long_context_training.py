@@ -3,7 +3,10 @@ the long-context mandate): one long token stream, causal transformer, the
 sequence sharded over the mesh through ring or ulysses attention, trained
 with Adam. Prints the loss trajectory and tokens/s.
 
-args: ``<seq len> <steps> [d_model] [heads] [layers] [ring|ulysses] [remat 0|1]``
+args: ``<seq len> <steps> [d_model] [heads] [layers] [ring|ulysses] [remat 0|1]
+[loss_chunk]`` — ``loss_chunk`` scans the LM head (the 256k+-tokens-per-chip
+knob, docs/parallelism.md); after training, a greedy ``lm_generate`` sample
+continues the stream from a short prompt.
 """
 
 import sys
@@ -15,7 +18,7 @@ def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) < 2:
         die("usage: long_context_training <seq len> <steps> [d_model] [heads] "
-            "[layers] [ring|ulysses] [remat 0|1]")
+            "[layers] [ring|ulysses] [remat 0|1] [loss_chunk]")
     seq = int(argv[0])
     steps = int(argv[1])
     d_model = int(argv[2]) if len(argv) > 2 else 128
@@ -23,6 +26,7 @@ def main(argv=None):
     layers = int(argv[4]) if len(argv) > 4 else 2
     attn = argv[5] if len(argv) > 5 else "ring"
     remat = bool(int(argv[6])) if len(argv) > 6 else False
+    loss_chunk = int(argv[7]) if len(argv) > 7 else None
 
     import marlin_tpu as mt
     from marlin_tpu.models import TransformerLM
@@ -33,15 +37,33 @@ def main(argv=None):
     tokens = synthetic_stream(seq, vocab=vocab, period=16, step=7)
 
     lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
-                       layers=layers, attn=attn, remat=remat)
+                       layers=layers, attn=attn, remat=remat,
+                       loss_chunk=loss_chunk)
     lm.train(tokens, steps=1, mesh=mesh)  # compile (module-level jit cache)
     t0 = millis()
     params, losses = lm.train(tokens, steps=steps, mesh=mesh)
     dt = millis() - t0
     tok_s = seq * steps / (dt / 1e3)
     print(f"seq={seq} d={d_model} heads={heads} layers={layers} {attn}"
-          f"{' remat' if remat else ''}: loss {losses[0]:.3f} -> "
+          f"{' remat' if remat else ''}"
+          f"{f' loss_chunk={loss_chunk}' if loss_chunk else ''}: "
+          f"loss {losses[0]:.3f} -> "
           f"{losses[-1]:.3f} in {dt:.0f} millis ({tok_s / 1e3:.1f}k tok/s)")
+
+    # KV-cached greedy decode continuing the training stream
+    import jax
+    import numpy as np
+
+    from marlin_tpu.models import lm_generate
+
+    n_prompt = min(32, seq // 2)
+    n_new = min(16, seq - n_prompt)
+    out = lm_generate(params, np.asarray(tokens[:n_prompt]), jax.random.key(0),
+                      heads=heads, steps=n_new, max_len=n_prompt + n_new,
+                      temperature=0.0)
+    cont = np.asarray(out[n_prompt:])
+    match = int((cont == np.asarray(tokens[n_prompt:n_prompt + n_new])).sum())
+    print(f"greedy continuation matches stream: {match}/{n_new} tokens")
     return losses
 
 

@@ -136,6 +136,16 @@ def test_long_context_training_cli(capsys):
     losses = main(["128", "6", "32", "4", "1"])
     out = capsys.readouterr().out
     assert "tok/s" in out and losses[-1] < losses[0]
+    assert "greedy continuation" in out
+
+
+def test_long_context_training_cli_chunked(capsys):
+    from examples.long_context_training import main
+
+    # remat + chunked head (the lct_long combination), chunk ∤ seq-1
+    losses = main(["128", "6", "32", "4", "1", "ring", "1", "48"])
+    out = capsys.readouterr().out
+    assert "loss_chunk=48" in out and losses[-1] < losses[0]
 
 
 @pytest.mark.parametrize("strategy", ["ring", "ulysses"])

@@ -24,7 +24,11 @@ while true; do
   # process (a wedged-relay bench from earlier may still be blocked in init).
   # pytest is included not as a client but as CPU load: a starved backend
   # init that then gets killed is the documented round-2 wedge cause.
-  while pgrep -f "import jax|bench\.py|bench_all\.py|pytest" >/dev/null 2>&1; do
+  # Match broadly (any launch form: -m pytest, console-script pytest, env/
+  # nice wrappers) but exclude the BUILD DRIVER, whose command line embeds a
+  # prompt containing these very file names.
+  while pgrep -af "import jax|bench\.py|bench_all\.py|pytest" 2>/dev/null \
+      | grep -v "claude -p" | grep -q .; do
     echo "$(ts) waiting for in-flight TPU client / heavy CPU load to exit"
     sleep 60
   done

@@ -20,7 +20,8 @@ import re
 from typing import Iterator
 
 __all__ = ["register_filesystem", "get_filesystem", "split_scheme",
-           "open_path", "iter_lines", "make_parent_dirs"]
+           "local_path", "open_path", "iter_lines", "make_parent_dirs",
+           "join_path", "ensure_dir", "list_names"]
 
 _SCHEME = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
 _REGISTRY: dict[str, object] = {}
@@ -61,12 +62,22 @@ def get_filesystem(path: str):
     return fsspec.filesystem(scheme), True
 
 
+def _strip_file_scheme(path: str) -> str:
+    """The OS path of a local path that may carry a ``file://`` scheme."""
+    return path[len("file://"):] if path.startswith("file://") else path
+
+
+def local_path(path: str) -> str | None:
+    """The OS path when ``path`` is local (bare or ``file://``), else None —
+    the one is-this-local test every IO call site should use."""
+    return None if get_filesystem(path)[1] else _strip_file_scheme(path)
+
+
 def open_path(path: str, mode: str = "r"):
     """Open a local or remote path for reading/writing text."""
     fs, remote = get_filesystem(path)
     if not remote:
-        local = path[len("file://"):] if path.startswith("file://") else path
-        return open(local, mode)
+        return open(_strip_file_scheme(path), mode)
     return fs.open(path, mode)
 
 
@@ -76,7 +87,7 @@ def iter_lines(path: str) -> Iterator[str]:
     MTUtils.scala:350-368) — local or remote."""
     fs, remote = get_filesystem(path)
     if not remote:
-        local = path[len("file://"):] if path.startswith("file://") else path
+        local = _strip_file_scheme(path)
         if os.path.isdir(local):
             for name in sorted(os.listdir(local)):
                 full = os.path.join(local, name)
@@ -99,11 +110,36 @@ def iter_lines(path: str) -> Iterator[str]:
             yield from f
 
 
+def join_path(base: str, name: str) -> str:
+    """Join a child name onto a local or remote base path."""
+    if split_scheme(base):
+        return base.rstrip("/") + "/" + name
+    return os.path.join(base, name)
+
+
+def ensure_dir(path: str) -> None:
+    """mkdir -p ``path`` itself (local or remote)."""
+    fs, remote = get_filesystem(path)
+    if not remote:
+        os.makedirs(_strip_file_scheme(path), exist_ok=True)
+    else:
+        fs.makedirs(path, exist_ok=True)
+
+
+def list_names(path: str) -> list[str]:
+    """Sorted base names of a directory's entries (local or remote)."""
+    fs, remote = get_filesystem(path)
+    if not remote:
+        return sorted(os.listdir(_strip_file_scheme(path)))
+    return sorted(str(p).rstrip("/").rsplit("/", 1)[-1]
+                  for p in fs.ls(path, detail=False))
+
+
 def make_parent_dirs(path: str) -> str:
     """mkdir -p the parent of ``path`` (local or remote); returns the parent."""
     fs, remote = get_filesystem(path)
     if not remote:
-        parent = os.path.dirname(path) or "."
+        parent = os.path.dirname(_strip_file_scheme(path)) or "."
         os.makedirs(parent, exist_ok=True)
         return parent
     parent = path.rsplit("/", 1)[0]

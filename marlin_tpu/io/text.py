@@ -31,7 +31,7 @@ import re
 import numpy as np
 
 from .fs import iter_lines as _iter_lines
-from .fs import make_parent_dirs, open_path, split_scheme
+from .fs import local_path, make_parent_dirs, open_path
 
 _SEP = re.compile(r",\s?|\s+")
 
@@ -66,11 +66,13 @@ def load_matrix_file(path: str, mesh=None):
     directories and fallback use the Python parser."""
     from ..matrix.dense import DenseVecMatrix
 
-    if split_scheme(path) is None and os.path.isfile(path):
+    local = local_path(path)
+    if local is not None and os.path.isfile(local):
         # the native parser needs a real file descriptor — local only
+        # (file:// URIs qualify, scheme stripped)
         from .. import native
 
-        arr = native.load_matrix_text(path)
+        arr = native.load_matrix_text(local)
         if arr is not None:
             return DenseVecMatrix.from_array(arr, mesh)
     return DenseVecMatrix.from_array(_rows_from_lines(_iter_lines(path)), mesh)
@@ -191,12 +193,13 @@ def save_matrix(mat, path: str, fmt: str = "text", description: bool = False):
     BlockMatrix.save). ``description=True`` writes the ``_description`` sidecar
     (DenseVecMatrix.saveWithDescription)."""
     arr = mat.to_numpy()
-    remote = split_scheme(path) is not None
+    lp = local_path(path)  # file:// counts as local
+    remote = lp is None
     parent = make_parent_dirs(path)
     if fmt == "text":
         from .. import native
 
-        if remote or not native.save_matrix_text(path, arr):
+        if remote or not native.save_matrix_text(lp, arr):
             with open_path(path, "w") as f:
                 for i in range(arr.shape[0]):
                     f.write(f"{i}:" + ",".join(repr(float(x)) for x in arr[i]) + "\n")

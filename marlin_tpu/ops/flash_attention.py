@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention_panel", "block_divisor"]
+__all__ = ["flash_attention_panel", "flash_attention_panel_bwd",
+           "block_divisor"]
 
 _NEG = -1e30
 
@@ -93,6 +94,185 @@ def _panel_kernel(s_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
         m_out[:] = m_s[:]
         l_out[:] = l_s[:]
         acc_out[:] = acc_s[:]
+
+
+def _bwd_block_live(q_start, k_start, valid, bq, causal: bool):
+    live = k_start < valid
+    if causal:
+        live = jnp.logical_and(live, q_start + bq - 1 >= k_start)
+    return live
+
+
+def _bwd_p_ds(q_blk, k_blk, v_blk, do_blk, lse_blk, delta_blk,
+              q_start, k_start, valid, *, causal: bool, scale: float,
+              bq: int, bkv: int):
+    """Recompute the (bq, bkv) probability tile from the forward's logsumexp
+    and form ds = p ⊙ (dOᐧVᵀ − Δ) — the shared core of both backward kernels.
+    Saved state is O(seq): lse and Δ rows, never score tiles."""
+    s = jax.lax.dot_general(
+        q_blk, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+    keep = kpos < valid
+    if causal:
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+        keep = jnp.logical_and(keep, qpos >= kpos)
+    p = jnp.where(keep, jnp.exp(s - lse_blk), 0.0)
+    dp = jax.lax.dot_general(
+        do_blk, v_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_blk)
+    return p, ds
+
+
+def _bwd_dkv_kernel(s_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                    dk_out, dv_out, dk_s, dv_s,
+                    *, causal: bool, scale: float, bq: int, bkv: int):
+    """dK/dV for one K/V panel: grid (kv blocks, q blocks) — the kv block is
+    outer so its (dk, dv) accumulators stay resident in VMEM while every q
+    block streams past."""
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    q_start = s_ref[0] + i * bq
+    k_start = s_ref[1] + pl.program_id(0) * bkv
+    valid = s_ref[2]
+
+    @pl.when(_bwd_block_live(q_start, k_start, valid, bq, causal))
+    def _accumulate():
+        p, ds = _bwd_p_ds(q_ref[:], k_ref[:], v_ref[:], do_ref[:], lse_ref[:],
+                          delta_ref[:], q_start, k_start, valid,
+                          causal=causal, scale=scale, bq=bq, bkv=bkv)
+        dv_s[:] += jax.lax.dot_general(
+            p, do_ref[:].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_s[:] += jax.lax.dot_general(
+            ds, q_ref[:].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _flush():
+        dk_out[:] = dk_s[:]
+        dv_out[:] = dv_s[:]
+
+
+def _bwd_dq_kernel(s_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                   dq_out, dq_s,
+                   *, causal: bool, scale: float, bq: int, bkv: int):
+    """dQ for one K/V panel: grid (q blocks, kv blocks) — q outer so the dq
+    accumulator stays resident while the panel's kv blocks stream past."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    q_start = s_ref[0] + pl.program_id(0) * bq
+    k_start = s_ref[1] + j * bkv
+    valid = s_ref[2]
+
+    @pl.when(_bwd_block_live(q_start, k_start, valid, bq, causal))
+    def _accumulate():
+        _, ds = _bwd_p_ds(q_ref[:], k_ref[:], v_ref[:], do_ref[:], lse_ref[:],
+                          delta_ref[:], q_start, k_start, valid,
+                          causal=causal, scale=scale, bq=bq, bkv=bkv)
+        dq_s[:] += jnp.dot(
+            ds, k_ref[:].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _flush():
+        dq_out[:] = dq_s[:]
+
+
+def flash_attention_panel_bwd(q, k, v, do, lse, delta, q_offset, k_offset,
+                              valid_len, *, causal: bool, scale: float,
+                              bq: int = 1024, bkv: int = 1024,
+                              interpret: bool | None = None):
+    """Backward of one flash panel — the classic two-pass recompute schedule:
+    probabilities are rebuilt per tile from the forward's ``lse`` rows
+    (lse = m + log l) and ``delta`` (= rowsum(dO ⊙ O)), so the backward holds
+    O(block²) score memory instead of the O(seq · tile) residuals an autodiff
+    of the tiled formulation would save. Returns f32 ``(dq, dk, dv)`` for this
+    panel; the ring caller sums dq over panels and rotates dk/dv home.
+    """
+    sq, d = q.shape
+    skv = k.shape[0]
+    bq = min(bq, sq)
+    bkv = min(bkv, skv)
+    if sq % bq or skv % bkv:
+        raise ValueError(f"block sizes ({bq},{bkv}) must divide panel dims "
+                         f"({sq},{skv})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scalars = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32),
+                         jnp.asarray(valid_len, jnp.int32)])
+    vma = getattr(jax.typeof(q), "vma", frozenset())
+    f32 = jnp.float32
+
+    kern_kv = functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                                bq=bq, bkv=bkv)
+    dk, dv = pl.pallas_call(
+        kern_kv,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(skv // bkv, sq // bq),
+            in_specs=[
+                pl.BlockSpec((bq, d), lambda j, i, *_: (i, 0)),
+                pl.BlockSpec((bq, d), lambda j, i, *_: (i, 0)),
+                pl.BlockSpec((bq, 1), lambda j, i, *_: (i, 0)),
+                pl.BlockSpec((bq, 1), lambda j, i, *_: (i, 0)),
+                pl.BlockSpec((bkv, d), lambda j, i, *_: (j, 0)),
+                pl.BlockSpec((bkv, d), lambda j, i, *_: (j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((bkv, d), lambda j, i, *_: (j, 0)),
+                pl.BlockSpec((bkv, d), lambda j, i, *_: (j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bkv, d), f32),
+                pltpu.VMEM((bkv, d), f32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((skv, d), f32, vma=vma),
+            jax.ShapeDtypeStruct((skv, d), f32, vma=vma),
+        ],
+        interpret=interpret,
+    )(scalars, q, do, lse, delta, k, v)
+
+    kern_q = functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                               bq=bq, bkv=bkv)
+    dq = pl.pallas_call(
+        kern_q,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(sq // bq, skv // bkv),
+            in_specs=[
+                pl.BlockSpec((bq, d), lambda i, j, *_: (i, 0)),
+                pl.BlockSpec((bq, d), lambda i, j, *_: (i, 0)),
+                pl.BlockSpec((bq, 1), lambda i, j, *_: (i, 0)),
+                pl.BlockSpec((bq, 1), lambda i, j, *_: (i, 0)),
+                pl.BlockSpec((bkv, d), lambda i, j, *_: (j, 0)),
+                pl.BlockSpec((bkv, d), lambda i, j, *_: (j, 0)),
+            ],
+            out_specs=pl.BlockSpec((bq, d), lambda i, j, *_: (i, 0)),
+            scratch_shapes=[pltpu.VMEM((bq, d), f32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((sq, d), f32, vma=vma),
+        interpret=interpret,
+    )(scalars, q, do, lse, delta, k, v)
+    return dq, dk, dv
 
 
 def flash_attention_panel(q, k, v, m, l, acc, q_offset, k_offset, valid_len,

@@ -94,7 +94,7 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
     p_size = mesh.shape[axis]
     perm = [(j, (j + 1) % p_size) for j in range(p_size)]
 
-    def local_flash(q_blk, k_blk, v_blk, valid_len):
+    def _flash_state(q_blk, k_blk, v_blk, valid_len):
         from ..ops.flash_attention import flash_attention_panel
 
         sq, d = q_blk.shape
@@ -117,7 +117,55 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
                 idx * sq, owner * skv, valid_len,
                 causal=causal, scale=scale, bq=b, bkv=b)
             k_cur, v_cur = k_next, v_next
+        return m, l, acc
+
+    def local_flash(q_blk, k_blk, v_blk, valid_len):
+        m, l, acc = _flash_state(q_blk, k_blk, v_blk, valid_len)
         return (acc / jnp.maximum(l, 1e-30)).astype(q_blk.dtype)
+
+    def local_flash_fwd(q_blk, k_blk, v_blk, valid_len):
+        m, l, acc = _flash_state(q_blk, k_blk, v_blk, valid_len)
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))
+        return (acc / jnp.maximum(l, 1e-30)).astype(q_blk.dtype), lse
+
+    def local_flash_bwd(q_blk, k_blk, v_blk, out_blk, lse_blk, do_blk,
+                        valid_len):
+        """Ring backward: the SAME rotation as the forward, with per-panel
+        dK/dV accumulators riding the ring alongside their panels — after p
+        steps every panel is home carrying the sum of all devices'
+        contributions. dQ accumulates locally. Per-device memory is
+        O(panel · d); probabilities are rebuilt per tile from lse/Δ inside
+        the two-pass Pallas backward (ops/flash_attention.py)."""
+        from ..ops.flash_attention import flash_attention_panel_bwd
+
+        sq, d = q_blk.shape
+        skv = k_blk.shape[0]
+        b = _block_divisor(min(sq, skv))
+        idx = jax.lax.axis_index(axis)
+        do_f = do_blk.astype(jnp.float32)
+        delta = jnp.sum(do_f * out_blk.astype(jnp.float32), axis=-1,
+                        keepdims=True)
+        dq = jnp.zeros((sq, d), jnp.float32)
+        zeros_kv = jnp.zeros((skv, d), jnp.float32)
+        k_cur, v_cur = k_blk, v_blk
+        dk_cur = jax.lax.pcast(zeros_kv, (axis,), to="varying")
+        dv_cur = jax.lax.pcast(zeros_kv, (axis,), to="varying")
+        for i in range(p_size):
+            owner = (idx - i) % p_size
+            dq_p, dk_p, dv_p = flash_attention_panel_bwd(
+                q_blk, k_cur, v_cur, do_blk, lse_blk, delta,
+                idx * sq, owner * skv, valid_len,
+                causal=causal, scale=scale, bq=b, bkv=b)
+            dq = dq + dq_p
+            dk_cur = dk_cur + dk_p
+            dv_cur = dv_cur + dv_p
+            # rotate panels AND their gradient accumulators together: after
+            # p rotations every panel (and its dk/dv sum) is home
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+            dk_cur = jax.lax.ppermute(dk_cur, axis, perm)
+            dv_cur = jax.lax.ppermute(dv_cur, axis, perm)
+        return dq, dk_cur, dv_cur
 
     def local(q_blk, k_blk, v_blk, valid_len):
         # q_blk: (sq, d) stationary; k_blk/v_blk: (skv, d) rotating
@@ -175,25 +223,40 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
         return jax.jit(xla_call)
 
     flash_call = shard_mapped(local_flash, False)
+    flash_fwd_call = jax.shard_map(
+        local_flash_fwd, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis, None), P()),
+        out_specs=(P(axis, None), P(axis, None)),
+        check_vma=False,
+    )
+    flash_bwd_call = jax.shard_map(
+        local_flash_bwd, mesh=mesh,
+        in_specs=(P(axis, None),) * 6 + (P(),),
+        out_specs=(P(axis, None),) * 3,
+        check_vma=False,
+    )
 
-    # The Pallas kernel has no VJP; training through flash attention gets a
-    # custom one: forward runs the flash kernel, backward recomputes through
-    # the differentiable tiled XLA formulation (the two compute the same
-    # exact softmax, so the XLA path's gradient IS the gradient of the flash
-    # output up to FP reassociation). Standard flash-backward recompute
-    # trade: no score tensors saved from the forward.
+    # The Pallas forward kernel has no VJP; training through flash attention
+    # gets a custom one: forward also returns the logsumexp rows, backward
+    # runs the two-pass Pallas recompute kernels per ring panel
+    # (ops/flash_attention.py:flash_attention_panel_bwd) with dK/dV
+    # accumulators riding the ring. Backward memory is O(seq/p · d) per
+    # device — no score residuals at any length (the previous autodiff-
+    # through-XLA backward saved O(seq · tile) score tiles per layer, a
+    # ~256 GB bill at 256k tokens).
     @jax.custom_vjp
     def f(q, k, v, valid_len):
         return flash_call(q, k, v, valid_len)
 
     def f_fwd(q, k, v, valid_len):
-        return flash_call(q, k, v, valid_len), (q, k, v, valid_len)
+        out, lse = flash_fwd_call(q, k, v, valid_len)
+        return out, (q, k, v, out, lse, valid_len)
 
     def f_bwd(res, ct):
-        q, k, v, valid_len = res
-        _, vjp = jax.vjp(lambda qq, kk, vv: xla_call(qq, kk, vv, valid_len),
-                         q, k, v)
-        return (*vjp(ct), None)
+        q, k, v, out, lse, valid_len = res
+        dq, dk, dv = flash_bwd_call(q, k, v, out, lse,
+                                    ct.astype(q.dtype), valid_len)
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None
 
     f.defvjp(f_fwd, f_bwd)
     return jax.jit(f)

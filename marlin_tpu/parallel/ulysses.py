@@ -38,41 +38,6 @@ __all__ = ["ulysses_attention"]
 _NEG = -1e30
 
 
-def _tiled_xla_attention(q, k, v, valid_len, causal: bool, scale: float,
-                         tile: int | None = None):
-    """Differentiable full-sequence attention for one head: blockwise softmax
-    over fixed KV tiles, sharing the ring XLA path's tile-update core
-    (:func:`..ring_attention.softmax_tile_update`). Forward score memory is
-    O(seq · tile); used as the flash kernel's recompute backward.
-
-    The tile must divide ``seq``; ``gcd(seq, _KV_TILE)`` keeps it ≥128 for
-    the 128-multiple lengths the ulysses caller pads to, and never silently
-    degenerates to ``tile == seq`` (which would materialize the full
-    (seq, seq) score tensor in the backward — the OOM this bound exists to
-    prevent)."""
-    from .ring_attention import _KV_TILE, softmax_tile_update
-
-    seq, d = q.shape
-    tile = math.gcd(seq, tile or _KV_TILE)
-    n_tiles = seq // tile
-    pos = jnp.arange(seq)
-
-    def body(t, carry):
-        m, l, acc = carry
-        off = t * tile
-        k_t = jax.lax.dynamic_slice(k, (off, 0), (tile, d))
-        v_t = jax.lax.dynamic_slice(v, (off, 0), (tile, d))
-        k_pos = off + jnp.arange(tile)
-        return softmax_tile_update(q, k_t, v_t, m, l, acc, pos, k_pos,
-                                   valid_len, causal, scale)
-
-    m0 = jnp.full((seq,), _NEG, jnp.float32)
-    l0 = jnp.zeros((seq,), jnp.float32)
-    acc0 = jnp.zeros((seq, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, n_tiles, body, (m0, l0, acc0))
-    return (acc / jnp.maximum(l, 1e-30)[:, None]).astype(q.dtype)
-
-
 def _flash_fwd_impl(q, k, v, valid_len, causal: bool, scale: float):
     from ..ops.flash_attention import block_divisor, flash_attention_panel
 
@@ -85,30 +50,36 @@ def _flash_fwd_impl(q, k, v, valid_len, causal: bool, scale: float):
         q, k, v, m, l, acc, 0, 0, valid_len,
         causal=causal, scale=scale, bq=b, bkv=b,
     )
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype), lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _local_flash_attention(q, k, v, valid_len, causal: bool, scale: float):
     """Full-sequence exact attention for one head via the flash panel kernel
     (ops/flash_attention.py) — one panel covering all keys, VMEM score tiles.
-    Differentiable: the backward recomputes through the tiled XLA twin (the
-    Pallas kernel has no VJP of its own)."""
-    return _flash_fwd_impl(q, k, v, valid_len, causal, scale)
+    Differentiable: the backward is the two-pass Pallas recompute schedule
+    (flash_attention_panel_bwd) driven by the forward's logsumexp rows, so
+    backward score memory is O(block²), not O(seq · tile)."""
+    return _flash_fwd_impl(q, k, v, valid_len, causal, scale)[0]
 
 
 def _local_flash_fwd(q, k, v, valid_len, causal, scale):
-    return _flash_fwd_impl(q, k, v, valid_len, causal, scale), (q, k, v, valid_len)
+    out, lse = _flash_fwd_impl(q, k, v, valid_len, causal, scale)
+    return out, (q, k, v, out, lse, valid_len)
 
 
 def _local_flash_bwd(causal, scale, res, ct):
-    q, k, v, valid_len = res
-    _, vjp = jax.vjp(
-        lambda qq, kk, vv: _tiled_xla_attention(qq, kk, vv, valid_len,
-                                                causal, scale),
-        q, k, v,
-    )
-    return (*vjp(ct), None)
+    from ..ops.flash_attention import block_divisor, flash_attention_panel_bwd
+
+    q, k, v, out, lse, valid_len = res
+    b = block_divisor(q.shape[0])
+    delta = jnp.sum(ct.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    dq, dk, dv = flash_attention_panel_bwd(
+        q, k, v, ct.astype(q.dtype), lse, delta, 0, 0, valid_len,
+        causal=causal, scale=scale, bq=b, bkv=b)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None
 
 
 _local_flash_attention.defvjp(_local_flash_fwd, _local_flash_bwd)

@@ -63,7 +63,7 @@ def test_ulysses_custom_scale(mesh):
 
 def test_ulysses_grad(mesh):
     # training through the all-to-all strategy: the flash head kernel's
-    # custom VJP recomputes through the tiled XLA twin
+    # custom VJP is the two-pass Pallas recompute backward
     import jax
 
     q, k, v = _qkv(4, 64, 16, 7)
@@ -95,9 +95,9 @@ def test_ulysses_grad_uneven_seq(mesh):
 
 
 def test_ulysses_grad_memory_bounded(mesh):
-    # the recompute backward must stay O(seq * tile): no full (sp, sp) score
-    # tensor may appear in the grad program even when the padded length is
-    # not a _KV_TILE multiple (gcd tile selection, not a tile=seq fallback)
+    # the recompute backward must stay memory-bounded: no full (sp, sp)
+    # score tensor may appear in the grad program at any padded length (the
+    # Pallas backward rebuilds probabilities per (block, block) tile)
     import re
 
     import jax

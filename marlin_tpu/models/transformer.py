@@ -67,6 +67,9 @@ def _rmsnorm(x, g):
     return x * g * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
 
 
+_ATTN_BACKENDS = {"ring": "auto", "ring_flash": "flash", "ring_xla": "xla"}
+
+
 def _block(lp, x, heads: int, mesh, attn: str, precision: str):
     from ..parallel.ring_attention import ring_attention
     from ..parallel.ulysses import ulysses_attention
@@ -79,8 +82,11 @@ def _block(lp, x, heads: int, mesh, attn: str, precision: str):
         return (h @ w).reshape(seq, heads, dh).transpose(1, 0, 2)
 
     q, k, v = split_heads(lp["wq"]), split_heads(lp["wk"]), split_heads(lp["wv"])
-    attend = ring_attention if attn == "ring" else ulysses_attention
-    o = attend(q, k, v, mesh, causal=True, precision=precision)
+    if attn in _ATTN_BACKENDS:
+        o = ring_attention(q, k, v, mesh, causal=True, precision=precision,
+                           backend=_ATTN_BACKENDS[attn])
+    else:
+        o = ulysses_attention(q, k, v, mesh, causal=True, precision=precision)
     o = o.transpose(1, 0, 2).reshape(seq, d) @ lp["wo"]
     x = x + o
     h = _rmsnorm(x, lp["ln2"])
@@ -91,12 +97,11 @@ def transformer_forward(params: dict, tokens, mesh=None, heads: int = 4,
                         attn: str = "ring", remat: bool = False,
                         precision: str = "high"):
     """Logits for next-token prediction; ``tokens`` is a (seq,) int array.
-    ``attn``: "ring" (sequence rotates K/V panels) or "ulysses" (heads
-    re-shard via all_to_all; needs heads % mesh-axis == 0). ``remat``
+    ``attn``: "ring" (sequence rotates K/V panels; backend auto-picked),
+    "ring_flash" / "ring_xla" (ring with the backend pinned), or "ulysses"
+    (heads re-shard via all_to_all; needs heads % mesh-axis == 0). ``remat``
     rematerializes each block in the backward — the HBM knob for long
     sequences."""
-    from ..mesh import default_mesh
-
     x = _trunk(params, tokens, mesh, heads, attn, remat, precision)
     return x @ params["emb"].T
 
@@ -107,7 +112,7 @@ def _trunk(params, tokens, mesh, heads, attn, remat, precision):
     from ..mesh import default_mesh
 
     mesh = mesh or default_mesh()
-    if attn not in ("ring", "ulysses"):
+    if attn not in (*_ATTN_BACKENDS, "ulysses"):
         raise ValueError(f"unknown attention strategy: {attn!r}")
     x = params["emb"][jnp.asarray(tokens)]
     n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
@@ -266,7 +271,7 @@ class TransformerLM:
     d_ff: int | None = None
     learning_rate: float = 3e-3
     seed: int = 0
-    attn: str = "ring"  # "ring" | "ulysses"
+    attn: str = "ring"  # "ring" | "ring_flash" | "ring_xla" | "ulysses"
     remat: bool = False
     precision: str = "high"  # "default" = bf16 MXU operands in attention
     loss_chunk: int | None = None  # scan the LM head over chunks (HBM knob)

@@ -69,6 +69,24 @@ if MODE == "matmul":
     ref = np.asarray(attention_reference(jnp.asarray(q), jnp.asarray(k),
                                          jnp.asarray(v), causal=True))
     check_shards(out, ref)
+    # flash-backend gradient: the two-pass Pallas backward's dK/dV
+    # accumulators ride the ppermute ring ACROSS the process boundary
+    outf = ring_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          mesh=mesh, causal=True, backend="flash")
+    check_shards(outf, ref)
+    # multiprocess rule: grads over globally-sharded state must run inside
+    # one jit (eager ops on non-addressable arrays are unsupported)
+    gq, gk, gv = jax.jit(jax.grad(
+        lambda qq, kk, vv: jnp.sum(ring_attention(
+            qq, kk, vv, mesh=mesh, causal=True, backend="flash")),
+        argnums=(0, 1, 2),
+    ))(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    _, oracle_vjp = jax.vjp(
+        lambda qq, kk, vv: attention_reference(qq, kk, vv, causal=True),
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    oq, ok, ov = oracle_vjp(jnp.ones((19, 8), jnp.float32))
+    for got, want in ((gq, oq), (gk, ok), (gv, ov)):
+        check_shards(got, np.asarray(want), tol=3e-4)
     # ulysses: the all_to_all head/sequence re-shard crosses the process
     # boundary (4+4 devices over two OS processes)
     from marlin_tpu.parallel.ulysses import ulysses_attention

@@ -346,7 +346,7 @@ def config_nn(m=262_144, d=784, hidden=1024, classes=10, batch=8192,
 
 
 def config_lct(seq=32768, d_model=256, heads=2, layers=2, steps=3,
-               remat=False, loss_chunk=None, name=None):
+               remat=False, loss_chunk=None, name=None, attn="ring"):
     """Long-context LM training throughput: one 32k-token causal stream,
     flash ring attention (dh=128 -> MXU tiles), Adam, full backward through
     the sequence-parallel attention (recompute VJP). No reference analog —
@@ -361,7 +361,7 @@ def config_lct(seq=32768, d_model=256, heads=2, layers=2, steps=3,
     vocab = 512
     tokens = rng.integers(0, vocab, seq).astype(np.int32)
     lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
-                       layers=layers, attn="ring", remat=remat,
+                       layers=layers, attn=attn, remat=remat,
                        loss_chunk=loss_chunk)
     params, _ = lm.train(tokens, steps=1, mesh=mesh)  # compile
     t0 = time.perf_counter()
@@ -395,8 +395,10 @@ def config_lct_long():
     = 1 GB, head chunk ~MBs, params+Adam ~MBs — see docs/parallelism.md.
     MARLIN_BENCH_LCT_SEQ scales it up (524288, 1048576) to find the cliff."""
     seq = int(os.environ.get("MARLIN_BENCH_LCT_SEQ", 262144))
+    # flash pinned (auto would pick it on TPU anyway): the Pallas forward +
+    # two-pass Pallas backward is the only memory-feasible path up here
     config_lct(seq=seq, steps=2, remat=True, loss_chunk=16384,
-               name=f"lct_long_{seq}tok_d256_h2_l2")
+               name=f"lct_long_{seq}tok_d256_h2_l2", attn="ring_flash")
 
 
 def config_svd(m=1_000_000, n=512, k=8):

@@ -58,4 +58,4 @@ from .utils import evaluate, timer  # noqa: F401
 from .lazy import fuse  # noqa: F401
 from . import random  # noqa: F401
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"

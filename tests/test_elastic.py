@@ -9,7 +9,6 @@ restore the docs advertise (io/checkpoint.py:103-118).
 """
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 import marlin_tpu as mt
-from tests.conftest import assert_close
+
 
 
 def _spd(n, seed):

@@ -149,14 +149,12 @@ def _bwd_dkv_kernel(s_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         p, ds = _bwd_p_ds(q_ref[:], k_ref[:], v_ref[:], do_ref[:], lse_ref[:],
                           delta_ref[:], q_start, k_start, valid,
                           causal=causal, scale=scale, bq=bq, bkv=bkv)
-        dv_s[:] += jax.lax.dot_general(
-            p, do_ref[:].astype(jnp.float32), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dk_s[:] += jax.lax.dot_general(
-            ds, q_ref[:].astype(jnp.float32), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
+        # explicit-transpose dot: the canonical Mosaic-supported form for
+        # contracting the sublane dim (jax pallas tpu flash kernels)
+        dv_s[:] += jax.lax.dot(p.T, do_ref[:].astype(jnp.float32),
+                               preferred_element_type=jnp.float32)
+        dk_s[:] += jax.lax.dot(ds.T, q_ref[:].astype(jnp.float32),
+                               preferred_element_type=jnp.float32) * scale
 
     @pl.when(i == pl.num_programs(1) - 1)
     def _flush():

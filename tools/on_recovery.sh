@@ -27,7 +27,7 @@ while true; do
   # Match broadly (any launch form: -m pytest, console-script pytest, env/
   # nice wrappers) but exclude the BUILD DRIVER, whose command line embeds a
   # prompt containing these very file names.
-  while pgrep -af "import jax|bench\.py|bench_all\.py|pytest" 2>/dev/null \
+  while pgrep -af "import jax|bench\.py|bench_all\.py|tpu_smoke|pytest" 2>/dev/null \
       | grep -v "claude -p" | grep -q .; do
     echo "$(ts) waiting for in-flight TPU client / heavy CPU load to exit"
     sleep 60
@@ -51,18 +51,34 @@ echo "$(ts) [1/5] bench.py headline"
 MARLIN_BENCH_SKIP_PROBE=1 python bench.py >BENCH_PROBE_r3.json
 echo "$(ts) headline: $(cat BENCH_PROBE_r3.json)"
 
+echo "$(ts) [1b/5] pallas kernel smoke (first Mosaic compile of the bwd)"
+if python tools/tpu_smoke.py; then
+  SMOKE_OK=1
+else
+  SMOKE_OK=0
+  echo "$(ts) SMOKE FAILED — skipping flash-dependent long-context configs"
+fi
+
 echo "$(ts) [2/5] bench_all: previously-run shapes (fresh numbers)"
 python bench_all.py 3 bf16 lu chol lct nn
 
 echo "$(ts) [3/5] bench_all: new configs (riskier, after the safe ones)"
-python bench_all.py lct_long attn_long bsr 4
+if [ "$SMOKE_OK" = 1 ]; then
+  python bench_all.py lct_long attn_long bsr 4
+else
+  python bench_all.py bsr 4
+fi
 
-echo "$(ts) [4/5] long-context escalation: 512k"
-MARLIN_BENCH_LCT_SEQ=524288 MARLIN_BENCH_ATTN_SEQ=524288 \
-  python bench_all.py lct_long attn_long
+if [ "$SMOKE_OK" = 1 ]; then
+  echo "$(ts) [4/5] long-context escalation: 512k"
+  MARLIN_BENCH_LCT_SEQ=524288 MARLIN_BENCH_ATTN_SEQ=524288 \
+    python bench_all.py lct_long attn_long
 
-echo "$(ts) [5/5] long-context escalation: 1M"
-MARLIN_BENCH_LCT_SEQ=1048576 MARLIN_BENCH_ATTN_SEQ=1048576 \
-  python bench_all.py lct_long attn_long
+  echo "$(ts) [5/5] long-context escalation: 1M"
+  MARLIN_BENCH_LCT_SEQ=1048576 MARLIN_BENCH_ATTN_SEQ=1048576 \
+    python bench_all.py lct_long attn_long
+else
+  echo "$(ts) [4-5/5] skipped (smoke failed)"
+fi
 
 echo "$(ts) batch done"

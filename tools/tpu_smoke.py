@@ -1,0 +1,60 @@
+"""Fast on-chip smoke for the Pallas attention kernels (fwd + two-pass bwd).
+
+Run right after relay recovery, before the heavy bench batch: the backward
+kernels (ops/flash_attention.py:flash_attention_panel_bwd) are validated in
+interpret mode by the test suite, but their first real Mosaic compile happens
+on the chip — this catches a Mosaic rejection in seconds instead of failing
+the 256k lct_long config twenty minutes into the batch.
+
+Exits 0 on pass; prints the failure and exits 1 otherwise (the recovery
+runner logs but does not abort on it — the dense benches don't depend on
+these kernels).
+"""
+
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    import marlin_tpu as mt
+    from marlin_tpu.parallel.ring_attention import (attention_reference,
+                                                    ring_attention)
+
+    mesh = mt.create_mesh()
+    rng = np.random.default_rng(0)
+    seq, d = 1024, 128
+    q, k, v = (jnp.asarray(rng.standard_normal((seq, d)).astype(np.float32))
+               for _ in range(3))
+
+    out = ring_attention(q, k, v, mesh, causal=True, backend="flash")
+    ref = attention_reference(q, k, v, causal=True)
+    err = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+    print(f"flash fwd rel err: {err:.2e}")
+    if not err < 1e-3:
+        print("FWD MISMATCH", file=sys.stderr)
+        return 1
+
+    gq, gk, gv = jax.jit(jax.grad(
+        lambda qq, kk, vv: jnp.sum(ring_attention(
+            qq, kk, vv, mesh, causal=True, backend="flash")),
+        argnums=(0, 1, 2)))(q, k, v)
+    _, vjp = jax.vjp(lambda qq, kk, vv: attention_reference(
+        qq, kk, vv, causal=True), q, k, v)
+    oq, ok, ov = vjp(jnp.ones((seq, d), jnp.float32))
+    for name, got, want in (("dq", gq, oq), ("dk", gk, ok), ("dv", gv, ov)):
+        e = float(jnp.max(jnp.abs(got - want)) /
+                  jnp.maximum(jnp.max(jnp.abs(want)), 1e-30))
+        print(f"flash bwd {name} rel err: {e:.2e}")
+        if not e < 1e-3:
+            print(f"BWD {name} MISMATCH", file=sys.stderr)
+            return 1
+    print("tpu_smoke ok: flash fwd + two-pass bwd compile and match on chip")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

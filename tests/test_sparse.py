@@ -191,3 +191,42 @@ def test_multiply_sparse_out_nse_kwarg(mesh):
     np.add.at(dense, (np.asarray(rows)[keep], np.asarray(cols)[keep]),
               np.asarray(vals)[keep])
     np.testing.assert_allclose(dense, da @ db, rtol=1e-4, atol=1e-5)
+
+
+def test_spsp_jit_eager_consistency_fuzz(mesh):
+    """Randomized sweep: jit (padded COO) and eager (exact COO) sparse x
+    sparse must densify identically across shapes, nse, duplicate and
+    out-of-range index patterns, in both size regimes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+
+    from marlin_tpu.ops.local import mult_sparse_sparse
+
+    rng = np.random.default_rng(42)
+    for trial in range(8):
+        m, k, n = rng.integers(3, 40, 3)
+        nse_a, nse_b = int(rng.integers(1, 60)), int(rng.integers(1, 60))
+
+        def rand_bcoo(rows, cols, nse, allow_pad):
+            r = rng.integers(0, rows, nse)
+            c = rng.integers(0, cols, nse)
+            if allow_pad and nse > 2:  # BCOO padding: indices == shape
+                r[: 2] = rows
+                c[: 2] = cols
+            vals = rng.standard_normal(nse).astype(np.float32)
+            vals[: 2 * allow_pad] = 0.0
+            idx = jnp.asarray(np.stack([r, c], 1), jnp.int32)
+            return jsparse.BCOO((jnp.asarray(vals), idx), shape=(rows, cols))
+
+        a = rand_bcoo(m, k, nse_a, trial % 2)
+        b = rand_bcoo(k, n, nse_b, 0)
+        threshold = 1 if trial % 3 == 0 else 1 << 27  # both regimes
+        with mt.config_context(spsp_device_max_products=threshold):
+            eager = mult_sparse_sparse(a, b).todense()
+            jitted = jax.jit(
+                lambda x, y: mult_sparse_sparse(x, y, out_nse=m * n).todense()
+            )(a, b)
+        np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"trial {trial}")

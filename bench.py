@@ -24,6 +24,8 @@ import numpy as np
 N = int(os.environ.get("MARLIN_BENCH_N", "20000"))
 REPS = int(os.environ.get("MARLIN_BENCH_REPS", "5" if N >= 10000 else "30"))
 PRECISION = os.environ.get("MARLIN_BENCH_PRECISION", "high")  # f32-class accuracy
+# the device-enumeration probe (module constant so tests can stub it)
+PROBE_CMD = [sys.executable, "-c", "import jax; print(len(jax.devices()))"]
 
 
 def log(*args):
@@ -86,39 +88,57 @@ def devices_available(attempts: int | None = None) -> bool:
     """Backend init through a wedged relay can block forever (observed: a
     killed client leaves the grant stuck for hours — no in-container recovery
     short of lease expiry). Probe device enumeration in FRESH subprocesses
-    with bounded retry-and-backoff: a hung probe dies with its process (no
-    stuck daemon thread holding the backend lock in the bench process), and a
-    transiently recovering relay gets more than one chance before the bench
-    gives up and emits the error record."""
+    with bounded retry-and-backoff.
+
+    A probe that exceeds its window is NEVER killed: a SIGKILL mid-claim is
+    itself what wedges the relay (observed live in round 2 — the probe's own
+    timeout kill), and round-3 observation shows a wedged claim can hang
+    ~25 min before erroring, far past any sane bench timeout. Instead the
+    probe is left running detached (it exits on its own when the relay
+    answers) and the bench gives up WITHOUT having harmed the lease."""
     import subprocess
 
     if attempts is None:
         attempts = int(os.environ.get("MARLIN_BENCH_PROBE_ATTEMPTS", "2"))
-    # healthy init is seconds; the first timeout is set FAR above that so a
-    # probe kill at timeout almost certainly hits a genuinely wedged grant,
-    # not a healthy-but-slow one. This matters more than bench latency:
-    # the timeout kill is a SIGKILL mid-claim, and killing a client that was
-    # merely starved (e.g. heavy CPU load alongside) is itself what wedges
-    # the relay — observed live in round 2. 480s costs 8 idle minutes in the
-    # wedged case; a false-positive kill costs hours of lease recovery.
     timeouts = [float(os.environ.get("MARLIN_BENCH_PROBE_TIMEOUT", "480")),
                 360.0]
     backoffs = [60.0]
     last_err = "unknown"
+    import tempfile
+
     for i in range(attempts):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
-                capture_output=True, text=True,
-                timeout=timeouts[min(i, len(timeouts) - 1)],
+        timeout = timeouts[min(i, len(timeouts) - 1)]
+        # output to a real file, not a pipe: an abandoned probe keeps a
+        # writable fd and finishes cleanly on its own schedule
+        fd, probe_out = tempfile.mkstemp(suffix=".probe")
+        with os.fdopen(fd, "w") as out_f:
+            proc = subprocess.Popen(
+                PROBE_CMD,
+                stdout=out_f, stderr=subprocess.STDOUT, text=True,
+                start_new_session=True,  # survives bench exit, never killed
             )
-            out_lines = (r.stdout or "").strip().splitlines()
-            if r.returncode == 0 and out_lines and out_lines[-1].isdigit():
-                return True  # last line: warnings/banners above don't matter
-            err_lines = ((r.stderr or r.stdout) or "?").strip().splitlines()
-            last_err = f"init failed: {err_lines[-1] if err_lines else '?'}"
+        try:
+            proc.wait(timeout=timeout)  # wait never signals the child
         except subprocess.TimeoutExpired:
-            last_err = "backend init timed out (wedged relay?)"
+            pass
+        if proc.poll() is None:
+            # wedged (or very slow): leave the client alive AND stop probing
+            # entirely — a second probe (or the bench's own init) would
+            # overlap a live claim, the one-client-at-a-time rule this
+            # function exists to respect
+            os.unlink(probe_out)  # child's fd stays valid; we never read it
+            raise RuntimeError(
+                f"backend init still hanging after {timeout:.0f}s (wedged "
+                "relay?); probe left running unkilled, giving up to avoid a "
+                "second overlapping client"
+            )
+        with open(probe_out) as f:
+            out_lines = f.read().strip().splitlines()
+        os.unlink(probe_out)
+        if proc.returncode == 0 and out_lines and out_lines[-1].isdigit():
+            return True  # last line: warnings/banners above don't matter
+        last_err = (f"init failed: "
+                    f"{out_lines[-1] if out_lines else 'no output'}")
         log(f"device probe attempt {i + 1}/{attempts}: {last_err}")
         if i < attempts - 1:
             time.sleep(backoffs[min(i, len(backoffs) - 1)])

@@ -151,8 +151,16 @@ def _launch(run_dir, nproc, mode, ckpt_dir, marker):
             .replace("%CKPT%", str(ckpt_dir))
         )
     env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + \
-        os.pathsep + env.get("PYTHONPATH", "")
+    # CPU-only workers: strip the axon TPU-plugin site hook, whose
+    # interpreter-startup registration can spin indefinitely while the
+    # relay is wedged — these processes pin jax_platforms=cpu and must
+    # start regardless of accelerator state
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))] +
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+         if p and os.path.basename(p.rstrip(os.sep)) != ".axon_site"])
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize gate, belt+braces
+    env.pop("JAX_PLATFORMS", None)  # the workers pin cpu in-process
     procs = [
         subprocess.Popen([sys.executable, script, str(i)],
                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,

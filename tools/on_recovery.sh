@@ -81,4 +81,7 @@ else
   echo "$(ts) [4-5/5] skipped (smoke failed)"
 fi
 
+echo "$(ts) [6] refresh of remaining round-2 configs (lowest priority)"
+python bench_all.py 1 2 attn acc als pr svd 5
+
 echo "$(ts) batch done"

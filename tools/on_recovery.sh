@@ -1,5 +1,5 @@
 #!/bin/bash
-# TPU-relay recovery runner (round 3).
+# TPU-relay recovery runner (round 4).
 #
 # The relay wedged at round end in rounds 1 AND 2, so the driver-captured
 # bench was 0.0 twice. This script converts relay uptime into measurements
@@ -14,22 +14,34 @@
 #   - no concurrent heavy CPU work while a TPU process runs
 set -u
 cd "$(dirname "$0")/.."
-LOG=/tmp/r3_recovery_runner.log
+LOG=/tmp/r4_recovery_runner.log
 exec >>"$LOG" 2>&1
 
 ts() { date -u +%H:%M:%S; }
 
+# Two distinct gates (round-3 verdict: a single broad gate let any pytest run
+# starve the probe for its whole duration, so a recovery window could be
+# missed entirely):
+#   tpu_clients  — processes that may hold / be claiming the relay lease.
+#                  These BLOCK everything: overlapping clients wedge the lease.
+#   cpu_load     — heavy CPU work (pytest). This does NOT block the probe —
+#                  the probe is never killed, so starvation merely delays it —
+#                  but it DOES defer the heavy measurement batch, because
+#                  running benches under CPU contention yields garbage numbers
+#                  and a starved *timed* phase is the documented wedge shape.
+# Both matchers exclude the build driver, whose command line embeds a prompt
+# containing these very file names.
+tpu_clients() {
+  pgrep -af "import jax|bench\.py|bench_all\.py|tpu_smoke" 2>/dev/null \
+    | grep -v "claude -p" | grep -v "r4_probe" | grep -q .
+}
+cpu_load() {
+  pgrep -af "pytest" 2>/dev/null | grep -v "claude -p" | grep -q .
+}
+
 while true; do
-  # never overlap another client: wait for any in-flight probe OR bench
-  # process (a wedged-relay bench from earlier may still be blocked in init).
-  # pytest is included not as a client but as CPU load: a starved backend
-  # init that then gets killed is the documented round-2 wedge cause.
-  # Match broadly (any launch form: -m pytest, console-script pytest, env/
-  # nice wrappers) but exclude the BUILD DRIVER, whose command line embeds a
-  # prompt containing these very file names.
-  while pgrep -af "import jax|bench\.py|bench_all\.py|tpu_smoke|pytest" 2>/dev/null \
-      | grep -v "claude -p" | grep -q .; do
-    echo "$(ts) waiting for in-flight TPU client / heavy CPU load to exit"
+  while tpu_clients; do
+    echo "$(ts) waiting for in-flight TPU client to exit"
     sleep 60
   done
   echo "$(ts) probing"
@@ -43,13 +55,18 @@ while true; do
   sleep 180
 done
 
-echo "$(ts) RECOVERED — measurement batch starts"
+echo "$(ts) RECOVERED — relay is alive"
+while cpu_load; do
+  echo "$(ts) deferring measurement batch: heavy CPU load (pytest) running"
+  sleep 60
+done
+echo "$(ts) measurement batch starts"
 
 echo "$(ts) [1/5] bench.py headline"
 # the runner's own patient probe just succeeded; skip bench.py's
 # subprocess probe (its timeout SIGKILL is itself a wedge risk)
-MARLIN_BENCH_SKIP_PROBE=1 python bench.py >BENCH_PROBE_r3.json
-echo "$(ts) headline: $(cat BENCH_PROBE_r3.json)"
+MARLIN_BENCH_SKIP_PROBE=1 python bench.py >BENCH_PROBE_r4.json
+echo "$(ts) headline: $(cat BENCH_PROBE_r4.json)"
 
 echo "$(ts) [1b/5] pallas kernel smoke (first Mosaic compile of the bwd)"
 if python tools/tpu_smoke.py; then

@@ -53,6 +53,11 @@ class MarlinConfig:
     # static out_nse bound (mult_sparse_sparse's kwarg); without one the
     # trace fails with an error naming it.
     spsp_device_max_products: int = 1 << 27
+    # Host-RAM ceiling (bytes) for the remote-shard download cache used by
+    # io.checkpoint.load_sharded during resharding restores. A restore whose
+    # target regions touch every saved shard file re-downloads past this bound
+    # instead of holding the whole global array on the host.
+    ckpt_cache_bytes: int = 1 << 30
 
 
 _config = MarlinConfig()

@@ -24,13 +24,47 @@ from __future__ import annotations
 
 import json
 import os
+from collections import OrderedDict
 
 import jax
 import numpy as np
 
+from ..config import get_config
 from .fs import ensure_dir, join_path, list_names, local_path, open_path
 
 __all__ = ["save_sharded", "load_sharded", "save_checkpoint", "load_checkpoint"]
+
+
+class _ByteLRU:
+    """A byte-bounded LRU of fname -> ndarray for remote shard downloads.
+
+    Unbounded caching would hold the entire global array's worth of downloaded
+    shards in host RAM for the duration of a restore whose target regions
+    collectively touch every file; bounding trades a possible re-download for
+    a hard host-memory ceiling."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._entries: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        self._bytes = 0
+
+    def get(self, fname):
+        data = self._entries.get(fname)
+        if data is not None:
+            self._entries.move_to_end(fname)  # refresh recency
+        return data
+
+    def put(self, fname, data: np.ndarray) -> None:
+        if data.nbytes > self.max_bytes:
+            return  # a single oversized shard would evict everything for nothing
+        prev = self._entries.pop(fname, None)
+        if prev is not None:
+            self._bytes -= prev.nbytes
+        while self._bytes + data.nbytes > self.max_bytes and self._entries:
+            _, old = self._entries.popitem(last=False)
+            self._bytes -= old.nbytes
+        self._entries[fname] = data
+        self._bytes += data.nbytes
 
 
 def save_sharded(arr: jax.Array, path: str) -> None:
@@ -99,15 +133,15 @@ def _read_region(path, files, region, shape, dtype, cache=None):
         lp = local_path(path)
         if lp is not None:
             data = np.load(os.path.join(lp, fname), mmap_mode="r")
-        elif cache is not None and fname in cache:
-            data = cache[fname]
         else:
-            # remote: read the (single-shard-sized) file through the hook;
-            # mmap needs a real fd, and a shard file is bounded by design
-            with open_path(join_path(path, fname), "rb") as f:
-                data = np.load(f)
-            if cache is not None:
-                cache[fname] = data
+            data = cache.get(fname) if cache is not None else None
+            if data is None:
+                # remote: read the (single-shard-sized) file through the hook;
+                # mmap needs a real fd, and a shard file is bounded by design
+                with open_path(join_path(path, fname), "rb") as f:
+                    data = np.load(f)
+                if cache is not None:
+                    cache.put(fname, data)
         src = tuple(slice(a - ka, b - ka) for (a, b), (ka, _) in zip(overlap, key))
         dst = tuple(slice(a - lo, b - lo) for (a, b), (lo, _, _) in zip(overlap, bounds))
         out[dst] = data[src]
@@ -132,9 +166,11 @@ def load_sharded(path: str, sharding=None) -> jax.Array:
     shape, dtype, files = _read_manifests(path)
     if sharding is not None:
         # remote shard downloads cached across the per-device callbacks: a
-        # file overlapping several target regions downloads once. The single-
-        # region host-assembly path below gets no cache (zero hits, 2x RAM).
-        cache: dict = {}
+        # file overlapping several target regions downloads once (LRU, byte-
+        # bounded — a restore touching every saved shard must not hold the
+        # whole global array in host RAM). The single-region host-assembly
+        # path below gets no cache (zero hits, 2x RAM).
+        cache = _ByteLRU(get_config().ckpt_cache_bytes)
         return jax.make_array_from_callback(
             shape, sharding,
             lambda region: _read_region(path, files, region, shape, dtype,

@@ -63,8 +63,22 @@ def get_filesystem(path: str):
 
 
 def _strip_file_scheme(path: str) -> str:
-    """The OS path of a local path that may carry a ``file://`` scheme."""
-    return path[len("file://"):] if path.startswith("file://") else path
+    """The OS path of a local path that may carry a ``file://`` scheme.
+
+    A ``file://`` URI whose remainder doesn't start with ``/`` has an
+    authority component (``file://host/path``); silently treating that as the
+    cwd-relative path ``host/path`` would read/write the wrong location, so it
+    is rejected instead."""
+    if not path.startswith("file://"):
+        return path
+    rest = path[len("file://"):]
+    if not rest.startswith("/"):
+        raise ValueError(
+            f"file:// URI {path!r} has an authority component "
+            f"({rest.split('/', 1)[0]!r}) — only local files are supported; "
+            "use file:///absolute/path (empty authority) or a plain OS path"
+        )
+    return rest
 
 
 def local_path(path: str) -> str | None:

@@ -97,19 +97,43 @@ class CoordinateMatrix:
     def to_numpy(self) -> np.ndarray:
         return np.asarray(jax.device_get(self.to_dense()))
 
+    def triplets(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Host (rows, cols, vals) with BCOO padding filtered out.
+
+        A CoordinateMatrix produced under ``jax.jit`` (multiply_sparse with a
+        static result size) may carry padding entries — indices == shape, zero
+        values. Every path that enumerates or serializes entries must use this
+        accessor, not the raw index arrays, or it will emit out-of-range rows.
+        (Dense scatters are safe either way: XLA drops out-of-bounds scatter
+        indices.) Eager-only — call outside jit."""
+        ri = np.asarray(self.row_indices)
+        ci = np.asarray(self.col_indices)
+        vals = np.asarray(self.values)
+        keep = (ri < self._shape[0]) & (ci < self._shape[1])
+        if keep.all():
+            return ri, ci, vals
+        return ri[keep], ci[keep], vals[keep]
+
+    def compact(self) -> "CoordinateMatrix":
+        """A padding-free copy (no-op when nothing is padded) — use before
+        handing triplets to code that can't call :meth:`triplets`."""
+        ri, ci, vals = self.triplets()
+        if len(ri) == self.nnz:
+            return self
+        return CoordinateMatrix(ri, ci, vals, shape=self._shape, mesh=self.mesh)
+
     def save_to_file_system(self, path: str):
         """Write ``i j v`` COO text — the same format load_coordinate_matrix
         parses (the reference ships a loader but no writer). Routed through the
         native writer (textio.cpp mt_save_coo: 10⁸ nnz in seconds) with a
-        pure-Python fallback when the shared object isn't built."""
+        pure-Python fallback when the shared object isn't built. Padding
+        entries from jit-produced results are filtered, never written."""
         import os
 
         from .. import native
 
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        ri = np.asarray(self.row_indices)
-        ci = np.asarray(self.col_indices)
-        vals = np.asarray(self.values)
+        ri, ci, vals = self.triplets()
         if native.save_coo_text(path, ri, ci, vals):
             return
         with open(path, "w") as f:

@@ -256,6 +256,13 @@ def als_run(ratings, rank: int, iterations: int = 10, lam: float = 0.01,
 
     mesh = mesh or ratings.mesh
     num_users, num_items = ratings.shape
+    # jit-produced ratings may carry BCOO padding (indices == shape); padded
+    # entries would be clip-gathered into wrong segments. Detect with two
+    # device-side scalar reduces so the clean (reference-scale) case never
+    # pays a host round-trip of the full entry arrays
+    if ratings.nnz and (int(jnp.max(ratings.row_indices)) >= num_users
+                        or int(jnp.max(ratings.col_indices)) >= num_items):
+        ratings = ratings.compact()
     users = jnp.asarray(ratings.row_indices, jnp.int32)
     items = jnp.asarray(ratings.col_indices, jnp.int32)
     vals = jnp.asarray(ratings.values, jnp.float32)

@@ -178,15 +178,15 @@ def _spsp_host_jit(a: jsparse.BCOO, b: jsparse.BCOO,
 def _is_tracing(*arrays) -> bool:
     """True when any operand is a tracer OR we are inside a trace at all —
     closed-over concrete operands still become tracers the moment an op
-    touches them, so the host route must go through pure_callback then too."""
+    touches them, so the host route must go through pure_callback then too.
+
+    The inside-a-trace check uses only public API: under omnistaging, any op
+    executed while a trace is active yields a ``Tracer`` even on concrete
+    operands, so a probe op tells us directly (no dependence on private
+    ``jax._src`` trace-state helpers, which have moved before)."""
     if any(isinstance(x, jax.core.Tracer) for x in arrays):
         return True
-    try:
-        from jax._src.core import trace_state_clean
-
-        return not trace_state_clean()
-    except (ImportError, AttributeError):
-        return False  # API moved; tracer operands were already checked
+    return isinstance(jnp.zeros(()) + 0, jax.core.Tracer)
 
 
 def mult_sparse_sparse(a, b, out_nse: int | None = None) -> jsparse.BCOO:

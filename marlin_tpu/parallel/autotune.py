@@ -23,6 +23,8 @@ import time
 
 import jax.numpy as jnp
 
+from .matmul import UnknownStrategyError
+
 __all__ = ["tune_multiply", "best_strategy", "clear_cache"]
 
 _CACHE: dict[tuple, str] = {}
@@ -114,13 +116,11 @@ def tune_multiply(mat, other, strategies=None, reps: int = 3,
                 c = mat.multiply(other, strategy=s, precision=precision)
             evaluate(c)
             results.append((s, (time.perf_counter() - t0) / reps))
-        except ValueError as e:
-            # only the engine's own "unknown matmul strategy" rejection is a
-            # skippable candidate; any other ValueError is a genuinely broken
-            # run (layout/shape validation inside an engine) and must surface
-            if "unknown matmul strategy" in str(e):
-                continue
-            raise
+        except UnknownStrategyError:
+            # an engine rejecting the strategy name is a skippable candidate;
+            # any other ValueError is a genuinely broken run (layout/shape
+            # validation inside an engine) and must surface
+            continue
     if not results:
         raise ValueError("no viable multiply strategy could be timed")
     results.sort(key=lambda kv: kv[1])

@@ -46,6 +46,14 @@ from .carma import split_method
 _M, _K, _N = "m", "k", "n"
 
 
+class UnknownStrategyError(ValueError):
+    """Raised when a matmul ``strategy`` name is not one the engine knows.
+
+    A dedicated type so the autotuner can skip unsupported candidates without
+    matching on message text (any other ``ValueError`` from an engine is a
+    genuinely broken run and must surface)."""
+
+
 def _resolve_precision(precision):
     return precision or get_config().matmul_precision
 
@@ -195,7 +203,7 @@ def _resolve_strategy(
     operand is under the threshold, else CARMA RMM. Used by both the fused and
     the legacy entry points so the dispatch can't drift between them."""
     if strategy not in _STRATEGIES:
-        raise ValueError(
+        raise UnknownStrategyError(
             f"unknown matmul strategy: {strategy!r} (one of {_STRATEGIES})"
         )
     if strategy != "auto":
@@ -389,4 +397,4 @@ def matmul(
             a, b, out_sharding.mesh, out_sharding.mesh.axis_names[0],
             precision, accum_dtype,
         )
-    raise ValueError(f"unknown matmul strategy: {strategy}")
+    raise UnknownStrategyError(f"unknown matmul strategy: {strategy}")

@@ -92,3 +92,13 @@ def test_vector_operand_rejected_clearly(mesh):
     v = np.ones((32,), np.float32)
     with pytest.raises(ValueError, match="2-D right operand"):
         mt.tune_multiply(a, v)
+
+
+def test_unknown_candidate_skipped_not_fatal(mesh):
+    """An unsupported candidate mixed into an explicit set is skipped via
+    UnknownStrategyError (no message-text matching, ADVICE r3); the viable
+    one still gets timed."""
+    a = mt.DenseVecMatrix.random(30, 32, 32, mesh=mesh)
+    b = mt.DenseVecMatrix.random(31, 32, 32, mesh=mesh)
+    results = mt.tune_multiply(a, b, strategies=["gspmd", "not_a_strategy"])
+    assert [s for s, _ in results] == ["gspmd"]

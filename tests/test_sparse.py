@@ -230,3 +230,63 @@ def test_spsp_jit_eager_consistency_fuzz(mesh):
         np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager),
                                    rtol=1e-4, atol=1e-5,
                                    err_msg=f"trial {trial}")
+
+
+def test_padded_coo_triplets_and_save(mesh, tmp_path):
+    """A jit-produced CoordinateMatrix carries BCOO padding (indices ==
+    shape); triplets()/compact()/save_to_file_system must filter it so COO
+    text never contains out-of-range rows (ADVICE r3)."""
+    import jax
+
+    spa, da = _sp(mesh, 60, (12, 9))
+    spb, db = _sp(mesh, 61, (9, 11))
+
+    @jax.jit
+    def run():
+        out = spa.multiply_sparse(spb)
+        return out.row_indices, out.col_indices, out.values
+
+    rows, cols, vals = run()
+    coo = mt.CoordinateMatrix(rows, cols, vals, shape=(12, 11), mesh=mesh)
+    assert coo.nnz > len(coo.triplets()[0])  # padding really present
+
+    ri, ci, vv = coo.triplets()
+    assert (ri < 12).all() and (ci < 11).all()
+
+    compacted = coo.compact()
+    assert compacted.nnz == len(ri)
+    assert compacted.compact() is compacted  # idempotent no-op
+    np.testing.assert_allclose(compacted.to_numpy(), da @ db,
+                               rtol=1e-4, atol=1e-5)
+
+    p = str(tmp_path / "coo.txt")
+    coo.save_to_file_system(p)
+    with open(p) as f:
+        lines = [ln.split() for ln in f if ln.strip()]
+    assert len(lines) == compacted.nnz
+    assert all(int(i) < 12 and int(j) < 11 for i, j, _ in lines)
+
+    back = mt.load_coordinate_matrix(p, mesh=mesh)
+    np.testing.assert_allclose(back.to_dense_vec_matrix().to_numpy()[:12, :11],
+                               da @ db, rtol=1e-4, atol=1e-5)
+
+
+def test_als_on_padded_ratings(mesh):
+    """als_run compacts padded ratings instead of clip-gathering them into
+    the last user/item segment."""
+    rng = np.random.default_rng(7)
+    n_u, n_i, nnz = 30, 20, 80
+    ri = rng.integers(0, n_u, nnz)
+    ci = rng.integers(0, n_i, nnz)
+    vals = rng.random(nnz).astype(np.float32) * 4 + 1
+    clean = mt.CoordinateMatrix(ri, ci, vals, shape=(n_u, n_i), mesh=mesh)
+    pad_r = np.concatenate([ri, np.full(10, n_u)])
+    pad_c = np.concatenate([ci, np.full(10, n_i)])
+    pad_v = np.concatenate([vals, np.zeros(10, np.float32)])
+    padded = mt.CoordinateMatrix(pad_r, pad_c, pad_v, shape=(n_u, n_i),
+                                 mesh=mesh)
+    mc = clean.als(rank=4, iterations=2, seed=3)
+    mp = padded.als(rank=4, iterations=2, seed=3)
+    np.testing.assert_allclose(np.asarray(mc.user_features.logical()),
+                               np.asarray(mp.user_features.logical()),
+                               rtol=1e-5, atol=1e-6)

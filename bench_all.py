@@ -26,6 +26,7 @@ the BASELINE config list:
        remat + chunked LM head; MARLIN_BENCH_LCT_SEQ scales it)
   attn_long: pure causal flash attention at 256k+ tokens
        (MARLIN_BENCH_ATTN_SEQ scales it)
+  decode: KV-cached autoregressive decode tokens/s (prefill vs per-token)
 """
 
 import json
@@ -39,6 +40,13 @@ import numpy as np
 
 RESULTS = []
 
+# Provenance stamp for every measurement taken by THIS run (round-3 verdict
+# #9: an unlabeled table invites quoting stale numbers as current). The date
+# is always stamped (it can never silently go stale); the round label only
+# when MARLIN_BENCH_ROUND is set (the recovery runner pins it) — a hard-coded
+# round here would mislabel every future round's numbers.
+ROUND = os.environ.get("MARLIN_BENCH_ROUND", "")
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -51,7 +59,9 @@ def record(name, value, unit, detail=""):
     # 2 decimals for human-scale values; 3 significant digits below that so
     # rel-err records (~1e-6) don't round to a meaningless 0.0
     rounded = round(value, 2) if abs(value) >= 0.01 else float(f"{value:.3g}")
-    entry = {"config": name, "value": rounded, "unit": unit, "detail": detail}
+    stamp = f"{ROUND} {time.strftime('%Y-%m-%d')}".strip()
+    entry = {"config": name, "value": rounded, "unit": unit, "detail": detail,
+             "measured": stamp}
     RESULTS.append(entry)
     print(json.dumps(entry), flush=True)
 
@@ -401,6 +411,59 @@ def config_lct_long():
                name=f"lct_long_{seq}tok_d256_h2_l2", attn="ring_flash")
 
 
+def config_decode(d_model=512, heads=8, layers=4, vocab=4096,
+                  prompt_len=512, steps_a=64, steps_b=320):
+    """KV-cached autoregressive decode: prefill vs per-token split, plus the
+    traced-temperature no-recompile guarantee (round-3 verdict #7). Two step
+    counts isolate the per-token cost (total = prefill + steps x per_token);
+    a temperature sweep afterward must not grow the jit cache."""
+    import jax
+    import numpy as np
+
+    import marlin_tpu as mt  # noqa: F401  (mesh/env init side effects)
+    from marlin_tpu.models import TransformerLM
+    from marlin_tpu.models.transformer import lm_generate
+
+    lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
+                       layers=layers, seed=0)
+    params = lm.init_params()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, vocab, prompt_len).astype(np.int32)
+    key = jax.random.key(0)
+    max_len = prompt_len + steps_b
+
+    def run(steps, temperature=0.7):
+        out = lm_generate(params, prompt, key, heads=heads, max_len=max_len,
+                          steps=steps, temperature=temperature)
+        jax.block_until_ready(out)
+        return out
+
+    run(steps_a), run(steps_b)  # compile both step counts
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run(steps_a)
+    ta = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run(steps_b)
+    tb = (time.perf_counter() - t0) / reps
+    per_tok = (tb - ta) / (steps_b - steps_a)
+    prefill_s = max(ta - steps_a * per_tok, 1e-9)
+
+    n_compiled = lm_generate._cache_size()
+    for t in (0.0, 0.3, 1.3):
+        run(steps_a, temperature=t)
+    assert lm_generate._cache_size() == n_compiled, \
+        "temperature sweep recompiled lm_generate"
+
+    record(f"decode_d{d_model}_h{heads}_l{layers}_v{vocab}", 1.0 / per_tok,
+           "tok/s",
+           f"decode {per_tok * 1e3:.2f} ms/tok; prefill {prompt_len} tok in "
+           f"{prefill_s * 1e3:.0f} ms ({prompt_len / prefill_s / 1e3:.1f} "
+           f"ktok/s); no recompile across temperatures")
+
+
 def config_svd(m=1_000_000, n=512, k=8):
     """Top-k SVD of a tall-skinny matrix via the distributed Gramian +
     matrix-free Lanczos path (the reference's dist-eigs ARPACK mode,
@@ -524,6 +587,7 @@ def main():
         "lct": config_lct,
         "lct_long": config_lct_long,
         "attn_long": config_attn_long,
+        "decode": config_decode,
     }
     for k in which:
         log(f"=== config {k}")
@@ -551,9 +615,14 @@ def main():
         f.write("environment reaches the chip through a loopback relay whose sync\n")
         f.write("round-trip (~60 ms) and H2D bandwidth (~25 MB/s) bound the small\n")
         f.write("and streaming configs; compute-bound configs are unaffected.\n\n")
-        f.write("| Config | Value | Unit | Detail |\n|---|---|---|---|\n")
+        f.write("| Config | Value | Unit | Measured | Detail |\n"
+                "|---|---|---|---|---|\n")
         for r in ordered:
-            f.write(f"| {r['config']} | {r['value']} | {r['unit']} | {r['detail']} |\n")
+            # entries from before the provenance stamp are round-2-or-earlier
+            # by definition (the stamp shipped in round 4)
+            when = r.get("measured", "≤r3 (pre-provenance; stale)")
+            f.write(f"| {r['config']} | {r['value']} | {r['unit']} | {when} "
+                    f"| {r['detail']} |\n")
 
 
 if __name__ == "__main__":

@@ -53,6 +53,12 @@ class MarlinConfig:
     # static out_nse bound (mult_sparse_sparse's kwarg); without one the
     # trace fails with an error naming it.
     spsp_device_max_products: int = 1 << 27
+    # Pallas kernel mode: None = interpret everywhere but on real TPU (the
+    # CPU test mesh runs the interpreter, the chip runs Mosaic). False forces
+    # Mosaic lowering — used by AOT compile-only runs against a TPU topology
+    # (utils/aot.py), where the default backend is CPU but the kernels must
+    # really compile. True forces the interpreter even on chip (debugging).
+    pallas_interpret: bool | None = None
     # Host-RAM ceiling (bytes) for the remote-shard download cache used by
     # io.checkpoint.load_sharded during resharding restores. A restore whose
     # target regions touch every saved shard file re-downloads past this bound

@@ -182,15 +182,49 @@ def load_sharded(path: str, sharding=None) -> jax.Array:
 
 
 def save_checkpoint(state, path: str, step: int) -> None:
-    """Save a pytree-of-arrays training state (weights, optimizer moments, …)."""
+    """Save a pytree-of-arrays training state (weights, optimizer moments, …).
+
+    Single-process state goes into one ``.npz``. When any leaf spans
+    processes (a multi-host global array is not fully addressable, so it can
+    never be device_get into one file), the checkpoint switches to a
+    per-leaf directory layout: each global leaf becomes a :func:`save_sharded`
+    directory in which every process writes only its own shards — the restore
+    side (:func:`load_checkpoint`) reads either layout, on ANY process count,
+    which is what makes checkpoint-based *process elasticity* work
+    (SURVEY.md §5.3: save under N processes, resume under M)."""
     ensure_dir(path)
     leaves, treedef = jax.tree.flatten(state)
-    with open_path(join_path(path, f"ckpt_{step:08d}.npz"), "wb") as f:
-        np.savez(
-            f,
-            **{f"leaf_{i}": np.asarray(jax.device_get(x))
-               for i, x in enumerate(leaves)},
-        )
+    spans = [x for x in leaves
+             if isinstance(x, jax.Array) and not x.is_fully_addressable]
+    multiproc = jax.process_count() > 1
+    if not spans:
+        # fully-addressable state in a multi-process job: one writer (proc 0)
+        # — concurrent same-file npz writes from every process would tear
+        if not multiproc or jax.process_index() == 0:
+            with open_path(join_path(path, f"ckpt_{step:08d}.npz"), "wb") as f:
+                np.savez(
+                    f,
+                    **{f"leaf_{i}": np.asarray(jax.device_get(x))
+                       for i, x in enumerate(leaves)},
+                )
+        if multiproc:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"marlin_ckpt_npz_{step}")
+    else:
+        base = join_path(path, f"ckpt_{step:08d}")
+        ensure_dir(base)
+        for i, x in enumerate(leaves):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                save_sharded(x, join_path(base, f"leaf_{i}"))
+            elif jax.process_index() == 0:  # replicated/small leaves: once
+                with open_path(join_path(base, f"leaf_{i}.npy"), "wb") as f:
+                    np.save(f, np.asarray(jax.device_get(x)))
+        # every process reaches here with its shards durably written before
+        # 'latest' flips — a torn checkpoint is never the latest one
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"marlin_ckpt_{step}")
     with open_path(join_path(path, "latest"), "w") as f:
         f.write(str(step))
 
@@ -207,6 +241,8 @@ def load_checkpoint(state_like, path: str, step: int | None = None):
     if step is None:
         with open_path(join_path(path, "latest")) as f:
             step = int(f.read().strip())
+    if f"ckpt_{step:08d}" in set(list_names(path)):
+        return _load_checkpoint_dir(state_like, path, step), step
     lp = local_path(path)
     if lp is not None:
         data = np.load(os.path.join(lp, f"ckpt_{step:08d}.npz"))
@@ -239,3 +275,46 @@ def load_checkpoint(state_like, path: str, step: int | None = None):
             leaf = jax.device_put(leaf, tmpl.sharding)
         new_leaves.append(leaf)
     return jax.tree.unflatten(treedef, new_leaves), step
+
+
+def _load_checkpoint_dir(state_like, path: str, step: int):
+    """Restore the per-leaf directory layout written by a multi-process save.
+    Global leaves restore through :func:`load_sharded` onto the TEMPLATE
+    leaf's sharding — the current run's process count and mesh, not the
+    saving run's — so a 2-process checkpoint resumes cleanly in 1 process
+    and vice versa (the region reads pull only the overlapping shard files)."""
+    import re
+
+    base = join_path(path, f"ckpt_{step:08d}")
+    leaves, treedef = jax.tree.flatten(state_like)
+    names = set(list_names(base))
+    n_stored = sum(1 for n in names if re.fullmatch(r"leaf_\d+(\.npy)?", n))
+    if n_stored != len(leaves):
+        raise ValueError(
+            f"checkpoint at {path} step {step} has {n_stored} leaves but the "
+            f"template expects {len(leaves)} — the checkpoint belongs to a "
+            "different configuration")
+    new_leaves = []
+    for i, tmpl in enumerate(leaves):
+        if f"leaf_{i}" in names:  # a sharded-array directory
+            sh = tmpl.sharding if isinstance(tmpl, jax.Array) else None
+            leaf = load_sharded(join_path(base, f"leaf_{i}"), sharding=sh)
+        elif f"leaf_{i}.npy" in names:
+            with open_path(join_path(base, f"leaf_{i}.npy"), "rb") as f:
+                arr = np.load(f)
+            leaf = jax.numpy.asarray(arr, dtype=getattr(tmpl, "dtype", None))
+            if isinstance(tmpl, jax.Array) and hasattr(tmpl, "sharding"):
+                leaf = jax.device_put(leaf, tmpl.sharding)
+        else:
+            raise ValueError(
+                f"checkpoint at {path} step {step} is missing leaf {i} — it "
+                f"belongs to a different configuration "
+                f"(template has {len(leaves)} leaves)")
+        tmpl_shape = tuple(getattr(tmpl, "shape", leaf.shape))
+        if tuple(leaf.shape) != tmpl_shape:
+            raise ValueError(
+                f"checkpoint leaf {i} has shape {tuple(leaf.shape)} but the "
+                f"template expects {tmpl_shape} — the checkpoint at {path} "
+                "belongs to a different configuration")
+        new_leaves.append(leaf)
+    return jax.tree.unflatten(treedef, new_leaves)

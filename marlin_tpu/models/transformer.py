@@ -218,45 +218,85 @@ def _decode_step(params, x, caches, pos, heads: int):
     return x @ params["emb"].T, new_caches
 
 
-@functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps",
-                                             "temperature"))
-def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
-                temperature: float = 0.0):
-    """KV-cached autoregressive decode: prefill the prompt, then sample
-    ``steps`` tokens (greedy at ``temperature=0``). One ``lax.scan`` over
-    positions — the whole generation is a single XLA program."""
-    vocab, d = params["emb"].shape
+def _prefill(params, prompt, heads: int, max_len: int):
+    """Process the whole prompt in ONE parallel forward — every projection is
+    a (P, d) @ (d, d) MXU matmul and the causal attention is one batched
+    einsum — returning the final-position hidden state plus per-layer KV
+    caches padded to ``max_len``. This is the standard prefill/decode split:
+    the scan in :func:`lm_generate` then runs only for *generated* tokens
+    (the previous formulation decoded the prompt position-by-position, P
+    sequential cache updates that no batch dimension could amortize)."""
     n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
+    P = prompt.shape[0]
+    d = params["emb"].shape[1]
     dh = d // heads
-    cdtype = params["emb"].dtype  # caches follow the params dtype (bf16-safe)
-    caches = {f"l{i}": (jnp.zeros((max_len, heads, dh), cdtype),
-                        jnp.zeros((max_len, heads, dh), cdtype))
-              for i in range(n_layers)}
+    cdtype = params["emb"].dtype
+    causal = jnp.tril(jnp.ones((P, P), bool))
+    x = params["emb"][prompt]
+    caches = {}
+    for i in range(n_layers):
+        lp = params[f"l{i}"]
+        h = _rmsnorm(x, lp["ln1"])
+        q, k, v = (jnp.reshape(h @ lp[w], (P, heads, dh))
+                   for w in ("wq", "wk", "wv"))
+        s = jnp.einsum("phd,thd->hpt", q, k) / math.sqrt(dh)
+        s = jnp.where(causal[None], s, -1e30)
+        o = jnp.einsum("hpt,thd->phd", jax.nn.softmax(s, axis=-1), v)
+        x = x + o.reshape(P, d) @ lp["wo"]
+        h = _rmsnorm(x, lp["ln2"])
+        x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        caches[f"l{i}"] = tuple(
+            jnp.zeros((max_len, heads, dh), cdtype).at[:P].set(t.astype(cdtype))
+            for t in (k, v))
+    logits = _rmsnorm(x[-1], params["ln_f"]) @ params["emb"].T
+    return logits, caches
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps"))
+def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
+                temperature=0.0):
+    """KV-cached autoregressive decode: batched prefill of the prompt (one
+    parallel forward, :func:`_prefill`), then one ``lax.scan`` sampling
+    ``steps`` tokens — the whole generation is a single XLA program.
+
+    ``temperature`` is a *traced* scalar (greedy at 0): sweeping sampling
+    settings reuses one compiled program instead of recompiling per value
+    (round-3 verdict #7)."""
     prompt = jnp.asarray(prompt, jnp.int32)
     n_prompt = prompt.shape[0]
     if n_prompt + steps > max_len:
         raise ValueError(
             f"prompt ({n_prompt}) + steps ({steps}) exceeds max_len "
             f"({max_len}); raise max_len or shorten the request")
-    tokens0 = jnp.zeros((max_len,), jnp.int32).at[:n_prompt].set(prompt)
+
+    temperature = jnp.asarray(temperature, jnp.float32)
+
+    def pick(logits, sub):
+        return jax.lax.cond(
+            temperature > 0.0,
+            lambda: jax.random.categorical(
+                sub, logits / jnp.maximum(temperature, 1e-6)).astype(jnp.int32),
+            lambda: jnp.argmax(logits).astype(jnp.int32),
+        )
+
+    logits0, caches = _prefill(params, prompt, heads, max_len)
+    key, sub = jax.random.split(key)
+    first = pick(logits0, sub)
+    tokens0 = (jnp.zeros((max_len,), jnp.int32)
+               .at[:n_prompt].set(prompt).at[n_prompt].set(first))
 
     def step(carry, pos):
         tokens, caches, key = carry
         x = params["emb"][tokens[pos]]
         logits, caches = _decode_step(params, x, caches, pos, heads)
         key, sub = jax.random.split(key)
-        if temperature > 0.0:
-            nxt = jax.random.categorical(sub, logits / temperature)
-        else:
-            nxt = jnp.argmax(logits)
-        # within the prompt, the "next token" is the given one (prefill)
-        nxt = jnp.where(pos + 1 < n_prompt, tokens[pos + 1], nxt.astype(jnp.int32))
-        tokens = tokens.at[pos + 1].set(nxt)  # pos+1 <= total <= max_len-1
+        nxt = pick(logits, sub)
+        tokens = tokens.at[pos + 1].set(nxt)  # pos+1 <= max_len-1
         return (tokens, caches, key), None
 
-    total = n_prompt + steps - 1
+    # positions n_prompt .. n_prompt+steps-2 generate tokens 2..steps
     (tokens, _, _), _ = jax.lax.scan(
-        step, (tokens0, caches, key), jnp.arange(total))
+        step, (tokens0, caches, key), n_prompt + jnp.arange(steps - 1))
     return tokens[: n_prompt + steps]
 
 
@@ -310,3 +350,16 @@ class TransformerLM:
                 save_checkpoint({"params": params, "opt_state": opt_state},
                                 checkpoint_dir, it + 1)
         return params, losses
+
+    def generate(self, params, prompt, steps: int = 32,
+                 max_len: int | None = None, temperature=0.0,
+                 seed: int | None = None):
+        """Sample ``steps`` tokens continuing ``prompt`` with the params
+        returned by :meth:`train` (see :func:`lm_generate`; ``temperature``
+        is traced — sweeping it reuses one compiled program)."""
+        key = jax.random.key(self.seed if seed is None else seed)
+        if max_len is None:
+            max_len = len(prompt) + steps
+        return lm_generate(params, prompt, key, heads=self.heads,
+                           max_len=max_len, steps=steps,
+                           temperature=temperature)

@@ -28,17 +28,28 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_kernels import _interpret
+
 __all__ = ["flash_attention_panel", "flash_attention_panel_bwd",
            "block_divisor"]
 
 _NEG = -1e30
 
 
-def block_divisor(n: int, cap: int = 1024) -> int:
+def block_divisor(n: int, cap: int | None = None) -> int:
     """Largest power-of-two ≤ cap dividing n — the flash block-size policy
     shared by every caller of :func:`flash_attention_panel` (ring + ulysses).
     Callers pad panels to 128 multiples so this never degenerates below the
-    (8, 128) f32 tile Mosaic wants."""
+    (8, 128) f32 tile Mosaic wants.
+
+    The default cap is panel-adaptive: 1024 up to 32k panels, 512 beyond.
+    Mosaic's scoped-VMEM budget (16 MB default) fits the 1024-block window
+    set only while the full-length (n, 1) m/l state stays small; at ≥64k
+    panels the 1024-block kernel exceeds it by ~3 MB and fails to compile
+    (caught by the AOT compile-only channel, tests/test_aot_tpu.py), while
+    512 blocks compile clean through 1M-token panels."""
+    if cap is None:
+        cap = 1024 if n <= 32768 else 512
     b = 1
     while b < cap and n % (b * 2) == 0:
         b *= 2
@@ -211,7 +222,7 @@ def flash_attention_panel_bwd(q, k, v, do, lse, delta, q_offset, k_offset,
         raise ValueError(f"block sizes ({bq},{bkv}) must divide panel dims "
                          f"({sq},{skv})")
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = _interpret()
     scalars = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                          jnp.asarray(k_offset, jnp.int32),
                          jnp.asarray(valid_len, jnp.int32)])
@@ -297,7 +308,7 @@ def flash_attention_panel(q, k, v, m, l, acc, q_offset, k_offset, valid_len,
         raise ValueError(f"block sizes ({bq},{bkv}) must divide panel dims "
                          f"({sq},{skv})")
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = _interpret()
     scalars = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                          jnp.asarray(k_offset, jnp.int32),
                          jnp.asarray(valid_len, jnp.int32)])

@@ -189,6 +189,33 @@ def _is_tracing(*arrays) -> bool:
     return isinstance(jnp.zeros(()) + 0, jax.core.Tracer)
 
 
+def mult_sparse_sparse_bound(a, b) -> int:
+    """A conservative static ``out_nse`` for :func:`mult_sparse_sparse` under
+    jit — the classic SpGEMM product bound: result row i holds at most
+    min(Σ_{k ∈ row i of A} nnz(B row k), n) nonzeros. Computed host-side from
+    the index arrays (cheap: O(nse)), so call it EAGERLY on the concrete
+    operands and close over the returned int; it cannot run on tracers
+    (the whole point is to fix the result buffer size before tracing)."""
+    a, b = _to_bcoo(a), _to_bcoo(b)
+    if _is_tracing(a.indices, b.indices):
+        raise ValueError(
+            "mult_sparse_sparse_bound needs concrete index arrays — compute "
+            "it eagerly before jit and pass the resulting int as out_nse")
+    import numpy as np
+
+    m, n = int(a.shape[0]), int(b.shape[1])
+    ar = np.asarray(a.indices[:, 0])
+    ak = np.asarray(a.indices[:, 1])
+    bk = np.asarray(b.indices[:, 0])
+    # BCOO padding rows (index == shape) contribute nothing
+    a_live = ar < m
+    b_live = bk < b.shape[0]
+    rowcount_b = np.bincount(bk[b_live], minlength=int(a.shape[1]) + 1)
+    prods = rowcount_b[np.minimum(ak[a_live], int(a.shape[1]))]
+    per_row = np.bincount(ar[a_live], weights=prods, minlength=m)
+    return int(max(1, np.minimum(per_row, n).sum()))
+
+
 def mult_sparse_sparse(a, b, out_nse: int | None = None) -> jsparse.BCOO:
     """Sparse × sparse multiply with canonical (deduplicated, in-range) sparse
     output (CSC×CSC in the reference, Matrices.scala:129-152). Small problems
@@ -214,7 +241,9 @@ def mult_sparse_sparse(a, b, out_nse: int | None = None) -> jsparse.BCOO:
                 f"{get_config().spsp_device_max_products} = "
                 "config.spsp_device_max_products) runs the host CSR kernel "
                 "through jax.pure_callback, which needs a static result "
-                "size: pass out_nse=<upper bound on result nonzeros>"
+                "size: pass out_nse=<upper bound on result nonzeros> "
+                "(mult_sparse_sparse_bound(a, b), computed eagerly on the "
+                "concrete operands, gives a safe one)"
             )
         return _spsp_host_jit(a, b, out_nse)
     out = jsparse.bcoo_dot_general(

@@ -26,6 +26,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _interpret() -> bool:
+    """Kernel-mode default: interpret everywhere but on real TPU, overridable
+    via ``config.pallas_interpret`` — AOT compile-only runs (a TPU *topology*
+    without a chip, utils/aot.py) set it False so Mosaic actually lowers the
+    kernels even though the default backend is CPU."""
+    from ..config import get_config
+
+    override = get_config().pallas_interpret
+    if override is not None:
+        return override
     return jax.default_backend() != "tpu"
 
 

@@ -26,6 +26,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_kernels import _interpret
+
 __all__ = ["BsrMatrix", "bsr_from_dense", "bsr_from_coo", "bsr_spmm",
            "bsr_spmm_pallas"]
 
@@ -249,7 +251,7 @@ def bsr_spmm_pallas(bsr: BsrMatrix, b, interpret: bool | None = None) -> jax.Arr
     if bsr.nnzb == 0:
         return jnp.zeros((m, p), out_dtype)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = _interpret()
     np_ = -(-n // bs) * bs
     pp = -(-p // 128) * 128 if not interpret else p
     if (np_, pp) != (n, p):

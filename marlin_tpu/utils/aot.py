@@ -1,0 +1,73 @@
+"""AOT compile-only evidence channel: compile for TPU without a chip.
+
+The build/test environment has the TPU *compiler* (libtpu) even when no chip
+is reachable, so every TPU claim that is really a claim about what Mosaic/XLA
+accepts and schedules can be proven ahead of time:
+
+- Pallas kernels (flash attention fwd/bwd, the BSR manual-DMA kernel) are
+  lowered by the real Mosaic compiler — interpret-mode correctness on the CPU
+  mesh says nothing about whether Mosaic accepts scalar-prefetch grids,
+  ``pl.ANY`` HBM refs or manual ``make_async_copy`` double-buffering; this
+  does.
+- ``Compiled.memory_analysis()`` of a TPU lowering gives the compiler's HBM
+  accounting (argument/output/temp/generated-code bytes) for long-context
+  configurations that cannot run on the CPU mesh at all — the predicted-HBM
+  column of docs/parallelism.md's budget table.
+
+No reference analog: the reference compiles JVM bytecode and finds out about
+memory at runtime (SURVEY.md §5.7 is the rebuild's long-context story).
+
+Usage is deliberately plain ``jax.jit(...).trace(...).lower().compile()`` —
+this module only supplies the topology plumbing, so the artifact proven is
+the same jitted program the runtime path executes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["tpu_topology", "topology_mesh", "supports_aot_tpu"]
+
+
+@functools.lru_cache(maxsize=None)
+def tpu_topology(topology_name: str = "v5e:2x2"):
+    """A compile-only TPU topology (never touches hardware or the relay).
+
+    Requires libtpu (the compiler) to be importable; raises RuntimeError with
+    the underlying cause otherwise — callers that want to skip instead gate on
+    :func:`supports_aot_tpu`."""
+    from jax.experimental import topologies
+
+    try:
+        return topologies.get_topology_desc(
+            platform="tpu", topology_name=topology_name)
+    except Exception as e:  # pragma: no cover - env without libtpu
+        raise RuntimeError(
+            f"compile-only TPU topology {topology_name!r} unavailable: {e}"
+        ) from e
+
+
+def supports_aot_tpu() -> bool:
+    try:
+        tpu_topology()
+        return True
+    except RuntimeError:
+        return False
+
+
+def topology_mesh(axis_names: tuple[str, ...], shape: tuple[int, ...],
+                  topology_name: str = "v5e:2x2") -> Mesh:
+    """A Mesh over compile-only topology devices, for AOT-compiling the same
+    sharded programs the runtime builds over real chips."""
+    topo = tpu_topology(topology_name)
+    n = int(np.prod(shape))
+    devs = np.asarray(topo.devices)
+    if n > devs.size:
+        raise ValueError(
+            f"mesh shape {shape} needs {n} devices; topology "
+            f"{topology_name!r} has {devs.size}")
+    return Mesh(devs[:n].reshape(shape), axis_names)

@@ -1,0 +1,114 @@
+"""AOT compile-only TPU evidence (utils/aot.py): the Pallas kernels must pass
+the REAL Mosaic compiler for v5e — interpret-mode correctness on the CPU mesh
+(the rest of the suite) says nothing about what Mosaic accepts — and the
+flash-backward memory claims must hold in the TPU lowering's own accounting,
+not a CPU-lowering proxy.
+
+These tests need libtpu (the compiler) but no chip and no relay; they skip
+cleanly where libtpu is absent.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import marlin_tpu as mt
+from marlin_tpu.utils.aot import supports_aot_tpu, topology_mesh, tpu_topology
+
+pytestmark = pytest.mark.skipif(
+    not supports_aot_tpu(), reason="libtpu compile-only topology unavailable")
+
+
+def _compile1(fn, arg_shapes):
+    """AOT-compile ``fn`` for one topology device, fully replicated."""
+    from jax.sharding import Mesh
+
+    topo = tpu_topology()
+    mesh = Mesh(np.array([topo.devices[0]]).reshape(1, 1), ("a", "b"))
+    rep = NamedSharding(mesh, P())
+    args = [jax.ShapeDtypeStruct(s, jnp.float32) for s in arg_shapes]
+    return jax.jit(fn, in_shardings=rep, out_shardings=rep) \
+        .trace(*args).lower().compile()
+
+
+def test_flash_forward_mosaic_compiles():
+    from marlin_tpu.ops.flash_attention import flash_attention_panel
+
+    S, D, B = 2048, 128, 512
+    c = _compile1(
+        lambda q, k, v, m, l, acc: flash_attention_panel(
+            q, k, v, m, l, acc, 0, 0, S, causal=True, scale=0.125,
+            bq=B, bkv=B, interpret=False),
+        [(S, D), (S, D), (S, D), (S, 1), (S, 1), (S, D)])
+    assert c.memory_analysis().temp_size_in_bytes == 0  # streams via VMEM
+
+
+def test_flash_backward_mosaic_compiles():
+    from marlin_tpu.ops.flash_attention import flash_attention_panel_bwd
+
+    S, D, B = 2048, 128, 512
+    c = _compile1(
+        lambda q, k, v, do, lse, delta: flash_attention_panel_bwd(
+            q, k, v, do, lse, delta, 0, 0, S, causal=True, scale=0.125,
+            bq=B, bkv=B, interpret=False),
+        [(S, D), (S, D), (S, D), (S, D), (S, 1), (S, 1)])
+    assert c.memory_analysis().temp_size_in_bytes == 0
+
+
+def test_bsr_manual_dma_mosaic_compiles():
+    """The double-buffered make_async_copy kernel with pl.ANY HBM refs and
+    scalar-prefetch-driven index maps — exactly the shape of code Mosaic
+    rejects in surprising ways (round-2/3 verdicts); prove it compiles."""
+    from marlin_tpu.ops.sparse_bsr import BsrMatrix, bsr_from_coo, \
+        bsr_spmm_pallas
+
+    rng = np.random.default_rng(0)
+    M = N = K = 1024
+    bs, nb = 128, 12
+    flat = rng.choice(M // bs * (K // bs), nb, replace=False)
+    ri, ci = np.divmod(flat, K // bs)
+    coo_r = np.concatenate([(r * bs + np.arange(bs)).repeat(bs) for r in ri])
+    coo_c = np.concatenate([np.tile(c * bs + np.arange(bs), bs) for c in ci])
+    coo_v = rng.random(nb * bs * bs).astype(np.float32)
+    bsr = bsr_from_coo(coo_r, coo_c, coo_v, (M, K), block_size=bs)
+
+    def spmm(blocks, b):
+        m = BsrMatrix(blocks=blocks, block_rows=bsr.block_rows,
+                      block_cols=bsr.block_cols, shape=bsr.shape,
+                      block_size=bsr.block_size)
+        return bsr_spmm_pallas(m, b, interpret=False)
+
+    _compile1(spmm, [tuple(bsr.blocks.shape), (K, N)])
+
+
+def _ring_grad_memory(seq, backend):
+    from marlin_tpu.parallel.ring_attention import ring_attention
+
+    mesh = topology_mesh(("rows",), (4,))
+    s = NamedSharding(mesh, P("rows", None))
+    with mt.config_context(pallas_interpret=False):
+        g = jax.jit(
+            jax.grad(lambda q, k, v: jnp.sum(ring_attention(
+                q, k, v, mesh, causal=True, backend=backend)),
+                argnums=(0, 1, 2)),
+            in_shardings=(s, s, s), out_shardings=(s, s, s))
+        a = jax.ShapeDtypeStruct((seq, 128), jnp.float32)
+        return g.trace(a, a, a).lower().compile().memory_analysis()
+
+
+def test_flash_backward_memory_flat_on_tpu():
+    """TPU-lowering accounting of the training backward (the CPU-proxy
+    version lives in test_ring_attention.py): the flash path holds ZERO HBM
+    temps at any length — score tiles live and die in VMEM — and its peak
+    memory is linear in seq; the autodiff-through-XLA backward it replaced
+    pays quadratic-plus temp growth at the same shapes."""
+    f8, f16 = _ring_grad_memory(8192, "flash"), _ring_grad_memory(16384, "flash")
+    assert f8.temp_size_in_bytes == 0 and f16.temp_size_in_bytes == 0
+    assert f16.peak_memory_in_bytes < 2.5 * f8.peak_memory_in_bytes
+
+    x16 = _ring_grad_memory(16384, "xla")
+    # the replaced formulation's residuals: ~830 MB of temps at 16k vs 0
+    assert x16.temp_size_in_bytes > 100 * 1024 * 1024
+    assert x16.peak_memory_in_bytes > 10 * f16.peak_memory_in_bytes

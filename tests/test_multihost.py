@@ -27,8 +27,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import marlin_tpu as mt
 
-assert len(jax.devices()) == 8, f"expected 8 global devices, got {len(jax.devices())}"
-mesh = mt.create_mesh((4, 2))
+assert len(jax.devices()) == 4 * NPROC, \
+    f"expected {4 * NPROC} global devices, got {len(jax.devices())}"
+# 8 devices (2 procs) -> 4x2; 4 devices (1 proc) -> 2x2: the elastic modes
+# deliberately restore on a DIFFERENT process count and mesh than they saved
+mesh = mt.create_mesh((4, 2) if NPROC == 2 else (2, 2))
 
 # global sharded matmul across both processes
 a_np = np.arange(64, dtype=np.float32).reshape(8, 8) / 64.0
@@ -112,6 +115,51 @@ elif MODE == "load":
     for sh in a2.addressable_shards:
         np.testing.assert_array_equal(np.asarray(sh.data), a_np[sh.index])
     print(f"proc {proc_id}: restore ok", flush=True)
+elif MODE in ("elastic_save", "elastic_resume"):
+    # PROCESS elasticity (round-3 verdict #6): a ResilientLoop trained under
+    # one process count checkpoints global (process-spanning) state; a later
+    # run under a DIFFERENT process count and mesh resumes it and continues
+    # the identical trajectory. Deterministic GD on a quadratic makes the
+    # trajectory comparable across process counts to fp tolerance.
+    from marlin_tpu.utils.failure import ResilientLoop
+
+    target_np = (np.arange(64, dtype=np.float32).reshape(8, 8) - 32.0) / 8.0
+    target = jax.make_array_from_callback((8, 8), sharding,
+                                          lambda idx: target_np[idx])
+    lr = 0.25
+
+    # multiprocess rules: global arrays may not be closed over or touched by
+    # eager ops — everything goes through jit arguments; the scalar loss
+    # output is replicated, so float() is legal on every process
+    @jax.jit
+    def gd(w, t):
+        w2 = w - lr * (w - t)
+        return w2, jnp.mean((w2 - t) ** 2)
+
+    def step_fn(state, i):
+        w, loss = gd(state["w"], target)
+        return {"w": w}, float(loss)
+
+    w0 = jax.make_array_from_callback(
+        (8, 8), sharding, lambda idx: np.zeros((8, 8), np.float32)[idx])
+
+    if MODE == "elastic_save":
+        loop = ResilientLoop(step_fn, str(ckpt_dir), checkpoint_every=2)
+        _, metrics = loop.run({"w": w0}, 6)
+        assert len(metrics) == 6
+        print(f"proc {proc_id}: elastic save ok {metrics[-1]:.8f}", flush=True)
+    else:
+        # resumed run: picks up at step 6 from the other world's checkpoint
+        loop = ResilientLoop(step_fn, str(ckpt_dir), checkpoint_every=2)
+        _, metrics = loop.run({"w": w0}, 12)
+        assert len(metrics) == 6, (len(metrics), "must resume at 6, not replay")
+        # oracle: the uninterrupted 12-step trajectory from the same init
+        w, oracle = {"w": w0}, []
+        for i in range(12):
+            w, m = step_fn(w, i)
+            oracle.append(m)
+        np.testing.assert_allclose(metrics, oracle[6:], rtol=1e-5, atol=1e-7)
+        print(f"proc {proc_id}: elastic resume ok", flush=True)
 
 # Ordered shutdown: the coordinator (proc 0) must outlive the workers — if it
 # dies first, the survivors' coordination-service poll thread fatals on
@@ -195,3 +243,27 @@ def test_two_process_checkpoint_restore(tmp_path):
     ckpt = tmp_path / "ckpt"
     _launch(tmp_path / "save_run", 2, "save", ckpt, "save ok")
     _launch(tmp_path / "load_run", 2, "load", ckpt, "restore ok")
+
+
+@pytest.mark.skipif(os.environ.get("MARLIN_SKIP_MULTIHOST") == "1",
+                    reason="multi-host test disabled")
+def test_process_elastic_2_to_1(tmp_path):
+    """Train under 2 processes (8 devices, 4x2), lose a process, resume the
+    SAME ResilientLoop trajectory under 1 process (4 devices, 2x2). The save
+    uses the per-leaf sharded layout (global leaves are not fully
+    addressable); the restore re-places regions onto the new world's mesh."""
+    ckpt = tmp_path / "eckpt"
+    _launch(tmp_path / "train2", 2, "elastic_save", ckpt, "elastic save ok")
+    _launch(tmp_path / "resume1", 1, "elastic_resume", ckpt,
+            "elastic resume ok")
+
+
+@pytest.mark.skipif(os.environ.get("MARLIN_SKIP_MULTIHOST") == "1",
+                    reason="multi-host test disabled")
+def test_process_elastic_1_to_2(tmp_path):
+    """The reverse: a 1-process world saves (single-file layout), a 2-process
+    world resumes it onto a process-spanning mesh — scale-UP elasticity."""
+    ckpt = tmp_path / "eckpt"
+    _launch(tmp_path / "train1", 1, "elastic_save", ckpt, "elastic save ok")
+    _launch(tmp_path / "resume2", 2, "elastic_resume", ckpt,
+            "elastic resume ok")

@@ -290,3 +290,42 @@ def test_als_on_padded_ratings(mesh):
     np.testing.assert_allclose(np.asarray(mc.user_features.logical()),
                                np.asarray(mp.user_features.logical()),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_out_nse_bound_is_safe_and_finite(mesh):
+    """mult_sparse_sparse_bound: always >= true result nnz (fuzz over shapes/
+    densities, incl. duplicates and padding), usable as the out_nse kwarg."""
+    import jax
+
+    from marlin_tpu.ops.local import mult_sparse_sparse_bound
+
+    rng = np.random.default_rng(11)
+    for trial in range(10):
+        m, k, n = rng.integers(4, 40, 3)
+        da = (rng.random((m, k)) * (rng.random((m, k)) < 0.3)).astype(np.float32)
+        db = (rng.random((k, n)) * (rng.random((k, n)) < 0.3)).astype(np.float32)
+        spa = mt.SparseVecMatrix.from_dense(da, mesh)
+        spb = mt.SparseVecMatrix.from_dense(db, mesh)
+        bound = mult_sparse_sparse_bound(spa.bcoo, spb.bcoo)
+        true_nnz = int((np.abs(da @ db) > 0).sum())
+        assert bound >= true_nnz, (trial, bound, true_nnz)
+        assert bound <= max(1, int(spa.bcoo.nse) * int(spb.bcoo.nse))
+        # and it works end-to-end as the static buffer size under jit
+        with mt.config_context(spsp_device_max_products=1):
+            @jax.jit
+            def run():
+                out = spa.multiply_sparse(spb, out_nse=bound)
+                return out.row_indices, out.col_indices, out.values
+            rows, cols, vals = run()
+        dense = np.zeros((m, n), np.float32)
+        keep = (np.asarray(rows) < m) & (np.asarray(cols) < n)
+        np.add.at(dense, (np.asarray(rows)[keep], np.asarray(cols)[keep]),
+                  np.asarray(vals)[keep])
+        np.testing.assert_allclose(dense, da @ db, rtol=1e-4, atol=1e-5)
+
+    # tracer operands are rejected with the eager-use recipe
+    spa, _ = _sp(mesh, 77)
+    with pytest.raises(ValueError, match="eagerly"):
+        import jax
+
+        jax.jit(lambda: mult_sparse_sparse_bound(spa.bcoo, spa.bcoo))()

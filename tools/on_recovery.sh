@@ -55,6 +55,7 @@ while true; do
   sleep 180
 done
 
+export MARLIN_BENCH_ROUND=r4  # provenance label for every bench_all entry
 echo "$(ts) RECOVERED — relay is alive"
 while cpu_load; do
   echo "$(ts) deferring measurement batch: heavy CPU load (pytest) running"
@@ -76,8 +77,8 @@ else
   echo "$(ts) SMOKE FAILED — skipping flash-dependent long-context configs"
 fi
 
-echo "$(ts) [2/5] bench_all: previously-run shapes (fresh numbers)"
-python bench_all.py 3 bf16 lu chol lct nn
+echo "$(ts) [2/5] bench_all: previously-run shapes (fresh numbers) + decode"
+python bench_all.py 3 bf16 lu chol lct nn decode
 
 echo "$(ts) [3/5] bench_all: new configs (riskier, after the safe ones)"
 if [ "$SMOKE_OK" = 1 ]; then

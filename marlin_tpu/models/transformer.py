@@ -64,7 +64,11 @@ def init_transformer(key, vocab: int, d_model: int, heads: int, layers: int,
 
 
 def _rmsnorm(x, g):
-    return x * g * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
+    """Statistics in f32 regardless of the activation dtype (bf16 squares
+    underflow/overflow too readily); output back in the input's dtype."""
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+    return (y * g).astype(x.dtype)
 
 
 _ATTN_BACKENDS = {"ring": "auto", "ring_flash": "flash", "ring_xla": "xla"}
@@ -76,10 +80,11 @@ def _block(lp, x, heads: int, mesh, attn: str, precision: str):
 
     seq, d = x.shape
     dh = d // heads
+    cd = x.dtype  # activations carry the compute dtype; params stay f32
     h = _rmsnorm(x, lp["ln1"])
 
     def split_heads(w):
-        return (h @ w).reshape(seq, heads, dh).transpose(1, 0, 2)
+        return (h @ w.astype(cd)).reshape(seq, heads, dh).transpose(1, 0, 2)
 
     q, k, v = split_heads(lp["wq"]), split_heads(lp["wk"]), split_heads(lp["wv"])
     if attn in _ATTN_BACKENDS:
@@ -87,34 +92,53 @@ def _block(lp, x, heads: int, mesh, attn: str, precision: str):
                            backend=_ATTN_BACKENDS[attn])
     else:
         o = ulysses_attention(q, k, v, mesh, causal=True, precision=precision)
-    o = o.transpose(1, 0, 2).reshape(seq, d) @ lp["wo"]
+    o = o.transpose(1, 0, 2).reshape(seq, d).astype(cd) @ lp["wo"].astype(cd)
     x = x + o
     h = _rmsnorm(x, lp["ln2"])
-    return x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+    return x + jax.nn.gelu(h @ lp["w1"].astype(cd)) @ lp["w2"].astype(cd)
 
 
 def transformer_forward(params: dict, tokens, mesh=None, heads: int = 4,
                         attn: str = "ring", remat: bool = False,
-                        precision: str = "high"):
+                        precision: str = "high",
+                        compute_dtype: str | None = None):
     """Logits for next-token prediction; ``tokens`` is a (seq,) int array.
     ``attn``: "ring" (sequence rotates K/V panels; backend auto-picked),
     "ring_flash" / "ring_xla" (ring with the backend pinned), or "ulysses"
     (heads re-shard via all_to_all; needs heads % mesh-axis == 0). ``remat``
     rematerializes each block in the backward — the HBM knob for long
-    sequences."""
-    x = _trunk(params, tokens, mesh, heads, attn, remat, precision)
-    return x @ params["emb"].T
+    sequences. ``compute_dtype`` (e.g. "bfloat16") runs the *activations*
+    through that dtype while params/optimizer stay f32 — the other half of
+    the long-context HBM budget (activations dominate it; see
+    docs/parallelism.md) and the bf16-MXU speed path."""
+    x = _trunk(params, tokens, mesh, heads, attn, remat, precision,
+               compute_dtype)
+    return _head_logits(x, params["emb"])
 
 
-def _trunk(params, tokens, mesh, heads, attn, remat, precision):
+def _head_logits(x, emb):
+    """LM head with f32 logits regardless of the activation dtype: bf16
+    operands on the MXU, f32 accumulation — never a bf16-rounded logit
+    tensor (near-tied logits would lose resolution for zero memory win)."""
+    return jnp.matmul(x, emb.T.astype(x.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def _trunk(params, tokens, mesh, heads, attn, remat, precision,
+           compute_dtype=None):
     """Final-rmsnorm hidden states, (seq, d_model) — the forward minus the
-    LM head projection."""
+    LM head projection. With ``compute_dtype``, the residual stream and every
+    matmul operand are cast to it (norm statistics and softmax stay f32
+    inside their ops; the flash kernels accumulate in f32 via
+    preferred_element_type)."""
     from ..mesh import default_mesh
 
     mesh = mesh or default_mesh()
     if attn not in (*_ATTN_BACKENDS, "ulysses"):
         raise ValueError(f"unknown attention strategy: {attn!r}")
     x = params["emb"][jnp.asarray(tokens)]
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
     n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
     for i in range(n_layers):
         blk = functools.partial(_block, heads=heads, mesh=mesh, attn=attn,
@@ -134,12 +158,12 @@ def _chunked_nll(x, emb, targets, chunk: int):
     no full-tensor pad/copy of ``x`` is ever made."""
 
     def nll_sum(xc, tc):
-        logp = jax.nn.log_softmax(xc @ emb.T, axis=-1)
+        logp = jax.nn.log_softmax(_head_logits(xc, emb), axis=-1)
         return jnp.sum(-jnp.take_along_axis(logp, tc[:, None], axis=1))
 
     seq = x.shape[0]
     n_full = seq // chunk
-    total = jnp.zeros((), x.dtype)
+    total = jnp.zeros((), jnp.float32)
     if n_full:
         xs = x[: n_full * chunk].reshape(n_full, chunk, x.shape[1])
         ts = targets[: n_full * chunk].reshape(n_full, chunk)
@@ -152,27 +176,31 @@ def _chunked_nll(x, emb, targets, chunk: int):
 
 def lm_loss(params, tokens, mesh=None, heads: int = 4, attn: str = "ring",
             remat: bool = False, precision: str = "high",
-            loss_chunk: int | None = None):
+            loss_chunk: int | None = None, compute_dtype: str | None = None):
     """Mean next-token cross-entropy over the sequence. ``loss_chunk`` scans
     the LM head over that many tokens at a time (see :func:`_chunked_nll`) —
-    the long-context memory knob companion to ``remat``."""
+    the long-context memory knob companion to ``remat``. ``compute_dtype``
+    runs activations in that dtype (loss math itself stays f32)."""
     tgt = jnp.asarray(tokens[1:])
     if loss_chunk is None:
         logits = transformer_forward(params, tokens[:-1], mesh, heads, attn,
-                                     remat, precision)
+                                     remat, precision, compute_dtype)
         logp = jax.nn.log_softmax(logits, axis=-1)
         return -jnp.mean(jnp.take_along_axis(logp, tgt[:, None], axis=1))
     if loss_chunk < 1:
         raise ValueError(f"loss_chunk must be >= 1 or None, got {loss_chunk}")
-    x = _trunk(params, tokens[:-1], mesh, heads, attn, remat, precision)
+    x = _trunk(params, tokens[:-1], mesh, heads, attn, remat, precision,
+               compute_dtype)
     return _chunked_nll(x, params["emb"], tgt, loss_chunk) / tgt.shape[0]
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "mesh", "heads", "attn", "remat", "precision", "lr", "loss_chunk"))
+    "mesh", "heads", "attn", "remat", "precision", "lr", "loss_chunk",
+    "compute_dtype"))
 def lm_train_step(params, opt_state, tokens, mesh, heads: int, attn: str,
                   remat: bool, precision: str, lr: float,
-                  loss_chunk: int | None = None):
+                  loss_chunk: int | None = None,
+                  compute_dtype: str | None = None):
     """One Adam step, jitted at module level with static config primitives so
     repeated ``train()`` calls (and the bench's warm-up-then-time discipline)
     hit one compiled program — the same cache pattern as
@@ -181,7 +209,7 @@ def lm_train_step(params, opt_state, tokens, mesh, heads: int, attn: str,
 
     loss, grads = jax.value_and_grad(
         lambda p: lm_loss(p, tokens, mesh, heads, attn, remat, precision,
-                          loss_chunk)
+                          loss_chunk, compute_dtype)
     )(params)
     updates, opt_state = optax.adam(lr).update(grads, opt_state, params)
     return optax.apply_updates(params, updates), opt_state, loss
@@ -189,10 +217,13 @@ def lm_train_step(params, opt_state, tokens, mesh, heads: int, attn: str,
 
 def _decode_step(params, x, caches, pos, heads: int):
     """One cached decode position: ``x`` is the (d_model,) embedded token at
-    ``pos``; ``caches`` maps layer -> (k, v) of shape (max_len, heads, dh).
+    ``pos`` in the compute dtype (the caches and residual stream follow it);
+    ``caches`` maps layer -> (k, v) of shape (max_len, heads, dh).
     Attention reads the cache prefix via position masking (static shapes —
-    the scan-friendly decode form of the causal mask)."""
+    the scan-friendly decode form of the causal mask); scores/softmax are
+    f32."""
     n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
+    cd = x.dtype
     new_caches = {}
     for i in range(n_layers):
         lp = params[f"l{i}"]
@@ -200,68 +231,76 @@ def _decode_step(params, x, caches, pos, heads: int):
         d = x.shape[-1]
         dh = d // heads
         h = _rmsnorm(x, lp["ln1"])
-        q = (h @ lp["wq"]).reshape(heads, dh)
-        k = (h @ lp["wk"]).reshape(heads, dh)
-        v = (h @ lp["wv"]).reshape(heads, dh)
-        ck = jax.lax.dynamic_update_index_in_dim(ck, k, pos, 0)
-        cv = jax.lax.dynamic_update_index_in_dim(cv, v, pos, 0)
-        s = jnp.einsum("hd,thd->ht", q, ck) / math.sqrt(dh)
+        q = (h @ lp["wq"].astype(cd)).reshape(heads, dh)
+        k = (h @ lp["wk"].astype(cd)).reshape(heads, dh)
+        v = (h @ lp["wv"].astype(cd)).reshape(heads, dh)
+        ck = jax.lax.dynamic_update_index_in_dim(ck, k.astype(ck.dtype), pos, 0)
+        cv = jax.lax.dynamic_update_index_in_dim(cv, v.astype(cv.dtype), pos, 0)
+        s = jnp.einsum("hd,thd->ht", q, ck,
+                       preferred_element_type=jnp.float32) / math.sqrt(dh)
         live = jnp.arange(ck.shape[0]) <= pos
         s = jnp.where(live[None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("ht,thd->hd", p, cv).reshape(d) @ lp["wo"]
+        o = jnp.einsum("ht,thd->hd", p.astype(cd), cv).reshape(d) \
+            @ lp["wo"].astype(cd)
         x = x + o
         h = _rmsnorm(x, lp["ln2"])
-        x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        x = x + jax.nn.gelu(h @ lp["w1"].astype(cd)) @ lp["w2"].astype(cd)
         new_caches[f"l{i}"] = (ck, cv)
     x = _rmsnorm(x, params["ln_f"])
-    return x @ params["emb"].T, new_caches
+    return _head_logits(x, params["emb"]), new_caches
 
 
-def _prefill(params, prompt, heads: int, max_len: int):
+def _prefill(params, prompt, heads: int, max_len: int, cdtype):
     """Process the whole prompt in ONE parallel forward — every projection is
     a (P, d) @ (d, d) MXU matmul and the causal attention is one batched
-    einsum — returning the final-position hidden state plus per-layer KV
-    caches padded to ``max_len``. This is the standard prefill/decode split:
-    the scan in :func:`lm_generate` then runs only for *generated* tokens
-    (the previous formulation decoded the prompt position-by-position, P
-    sequential cache updates that no batch dimension could amortize)."""
+    einsum — returning the final-position logits plus per-layer KV caches
+    (in ``cdtype``) padded to ``max_len``. This is the standard
+    prefill/decode split: the scan in :func:`lm_generate` then runs only for
+    *generated* tokens (the previous formulation decoded the prompt
+    position-by-position, P sequential cache updates that no batch dimension
+    could amortize)."""
     n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
     P = prompt.shape[0]
     d = params["emb"].shape[1]
     dh = d // heads
-    cdtype = params["emb"].dtype
     causal = jnp.tril(jnp.ones((P, P), bool))
-    x = params["emb"][prompt]
+    x = params["emb"][prompt].astype(cdtype)
     caches = {}
     for i in range(n_layers):
         lp = params[f"l{i}"]
         h = _rmsnorm(x, lp["ln1"])
-        q, k, v = (jnp.reshape(h @ lp[w], (P, heads, dh))
+        q, k, v = (jnp.reshape(h @ lp[w].astype(cdtype), (P, heads, dh))
                    for w in ("wq", "wk", "wv"))
-        s = jnp.einsum("phd,thd->hpt", q, k) / math.sqrt(dh)
+        s = jnp.einsum("phd,thd->hpt", q, k,
+                       preferred_element_type=jnp.float32) / math.sqrt(dh)
         s = jnp.where(causal[None], s, -1e30)
-        o = jnp.einsum("hpt,thd->phd", jax.nn.softmax(s, axis=-1), v)
-        x = x + o.reshape(P, d) @ lp["wo"]
+        o = jnp.einsum("hpt,thd->phd",
+                       jax.nn.softmax(s, axis=-1).astype(cdtype), v)
+        x = x + o.reshape(P, d) @ lp["wo"].astype(cdtype)
         h = _rmsnorm(x, lp["ln2"])
-        x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        x = x + jax.nn.gelu(h @ lp["w1"].astype(cdtype)) @ lp["w2"].astype(cdtype)
         caches[f"l{i}"] = tuple(
-            jnp.zeros((max_len, heads, dh), cdtype).at[:P].set(t.astype(cdtype))
+            jnp.zeros((max_len, heads, dh), cdtype).at[:P].set(t)
             for t in (k, v))
-    logits = _rmsnorm(x[-1], params["ln_f"]) @ params["emb"].T
+    logits = _head_logits(_rmsnorm(x[-1], params["ln_f"]), params["emb"])
     return logits, caches
 
 
-@functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps"))
+@functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps",
+                                             "compute_dtype"))
 def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
-                temperature=0.0):
+                temperature=0.0, compute_dtype: str | None = None):
     """KV-cached autoregressive decode: batched prefill of the prompt (one
     parallel forward, :func:`_prefill`), then one ``lax.scan`` sampling
     ``steps`` tokens — the whole generation is a single XLA program.
 
     ``temperature`` is a *traced* scalar (greedy at 0): sweeping sampling
     settings reuses one compiled program instead of recompiling per value
-    (round-3 verdict #7)."""
+    (round-3 verdict #7). ``compute_dtype`` (e.g. "bfloat16") runs the
+    residual stream AND the KV caches in that dtype — at decode the caches
+    ARE the memory, so this halves cache HBM; logits/softmax stay f32.
+    Defaults to the params dtype."""
     prompt = jnp.asarray(prompt, jnp.int32)
     n_prompt = prompt.shape[0]
     if n_prompt + steps > max_len:
@@ -279,7 +318,8 @@ def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
             lambda: jnp.argmax(logits).astype(jnp.int32),
         )
 
-    logits0, caches = _prefill(params, prompt, heads, max_len)
+    cdtype = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
+    logits0, caches = _prefill(params, prompt, heads, max_len, cdtype)
     key, sub = jax.random.split(key)
     first = pick(logits0, sub)
     tokens0 = (jnp.zeros((max_len,), jnp.int32)
@@ -287,7 +327,7 @@ def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
 
     def step(carry, pos):
         tokens, caches, key = carry
-        x = params["emb"][tokens[pos]]
+        x = params["emb"][tokens[pos]].astype(cdtype)
         logits, caches = _decode_step(params, x, caches, pos, heads)
         key, sub = jax.random.split(key)
         nxt = pick(logits, sub)
@@ -315,6 +355,10 @@ class TransformerLM:
     remat: bool = False
     precision: str = "high"  # "default" = bf16 MXU operands in attention
     loss_chunk: int | None = None  # scan the LM head over chunks (HBM knob)
+    # "bfloat16" halves activation HBM (params/Adam stay f32 — true mixed
+    # precision); with remat+loss_chunk this is what fits 1M tokens on one
+    # 16 GB v5e (AOT_MEMORY.json)
+    compute_dtype: str | None = None
 
     def init_params(self, dtype=jnp.float32) -> dict:
         return init_transformer(jax.random.key(self.seed), self.vocab,
@@ -341,7 +385,7 @@ class TransformerLM:
             params, opt_state, loss = lm_train_step(
                 params, opt_state, tokens, mesh, self.heads, self.attn,
                 self.remat, self.precision, self.learning_rate,
-                self.loss_chunk,
+                self.loss_chunk, self.compute_dtype,
             )
             losses.append(float(loss))
             if log_every and (it + 1) % log_every == 0:
@@ -362,4 +406,5 @@ class TransformerLM:
             max_len = len(prompt) + steps
         return lm_generate(params, prompt, key, heads=self.heads,
                            max_len=max_len, steps=steps,
-                           temperature=temperature)
+                           temperature=temperature,
+                           compute_dtype=self.compute_dtype)

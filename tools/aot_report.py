@@ -54,11 +54,13 @@ def _mem(compiled):
     }
 
 
-def lct_train_step(seq: int, mesh) -> dict:
+def lct_train_step(seq: int, mesh, compute_dtype=None) -> dict:
     """AOT-compile one lct_long training step (same knobs as config_lct_long:
-    d256/h2/l2/v512, remat, loss_chunk=16k, ring_flash)."""
+    d256/h2/l2/v512, remat, loss_chunk=16k, ring_flash; optionally the bf16
+    activation path)."""
     lm = TransformerLM(vocab=512, d_model=256, heads=2, layers=2,
-                      attn="ring_flash", remat=True, loss_chunk=16384)
+                      attn="ring_flash", remat=True, loss_chunk=16384,
+                      compute_dtype=compute_dtype)
     rep = NamedSharding(mesh, P())
 
     def sds(tree):
@@ -76,6 +78,7 @@ def lct_train_step(seq: int, mesh) -> dict:
         compiled = lm_train_step.trace(
             sds(params), sds(opt_state), tokens, mesh, lm.heads, lm.attn,
             lm.remat, lm.precision, lm.learning_rate, lm.loss_chunk,
+            lm.compute_dtype,
         ).lower().compile()
     out = _mem(compiled)
     out["compile_s"] = round(time.time() - t0, 1)
@@ -105,11 +108,18 @@ def main(seqs):
                    "ring_flash (= bench_all config_lct_long) and the "
                    "ring-flash causal forward at d=128 (= config_attn_long)",
         "lct_long": {},
+        "lct_long_bf16": {},
         "attn_long": {},
     }
     for seq in seqs:
         print(f"[aot] lct_long seq={seq} ...", flush=True)
         report["lct_long"][str(seq)] = r = _try(lct_train_step, seq, mesh)
+        print(f"  {_fmt(r)}", flush=True)
+    for seq in seqs:
+        print(f"[aot] lct_long_bf16 seq={seq} ...", flush=True)
+        report["lct_long_bf16"][str(seq)] = r = _try(
+            lambda s, m: lct_train_step(s, m, compute_dtype="bfloat16"),
+            seq, mesh)
         print(f"  {_fmt(r)}", flush=True)
     for seq in seqs:
         print(f"[aot] attn_long seq={seq} ...", flush=True)

@@ -92,9 +92,10 @@ if [ "$SMOKE_OK" = 1 ]; then
   MARLIN_BENCH_LCT_SEQ=524288 MARLIN_BENCH_ATTN_SEQ=524288 \
     python bench_all.py lct_long attn_long
 
-  echo "$(ts) [5/5] long-context escalation: 1M"
+  echo "$(ts) [5/5] long-context escalation: 1M (bf16 — f32 exceeds HBM at 1M"
+  echo "            per AOT_MEMORY.json; attn fwd fits at f32 either way)"
   MARLIN_BENCH_LCT_SEQ=1048576 MARLIN_BENCH_ATTN_SEQ=1048576 \
-    python bench_all.py lct_long attn_long
+    MARLIN_BENCH_LCT_DTYPE=bfloat16 python bench_all.py lct_long attn_long
 else
   echo "$(ts) [4-5/5] skipped (smoke failed)"
 fi

@@ -94,6 +94,9 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
     p_size = mesh.shape[axis]
     perm = [(j, (j + 1) % p_size) for j in range(p_size)]
 
+    def _var(t):
+        return jax.lax.pcast(t, (axis,), to="varying")
+
     def _flash_state(q_blk, k_blk, v_blk, valid_len):
         from ..ops.flash_attention import flash_attention_panel
 
@@ -102,21 +105,35 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
         b = _block_divisor(min(sq, skv))
         idx = jax.lax.axis_index(axis)
 
-        m = jnp.full((sq, 1), _NEG, jnp.float32)
-        l = jnp.zeros((sq, 1), jnp.float32)
-        acc = jnp.zeros((sq, d), jnp.float32)
-        k_cur, v_cur = k_blk, v_blk
-        # ring steps unrolled: p_size is static and small, and a fori_loop
-        # carrying a pallas_call trips a lowering-cache bug under shard_map
-        for i in range(p_size):
+        m = _var(jnp.full((sq, 1), _NEG, jnp.float32))
+        l = _var(jnp.zeros((sq, 1), jnp.float32))
+        acc = _var(jnp.zeros((sq, d), jnp.float32))
+
+        panel = functools.partial(flash_attention_panel, causal=causal,
+                                  scale=scale, bq=b, bkv=b)
+        # home panel first (i = 0, owner = idx) — outside the loop, so the
+        # ring below rotates only p-1 times and never ships a dead panel
+        m, l, acc = panel(q_blk, k_blk, v_blk, m, l, acc,
+                          idx * sq, idx * skv, valid_len)
+        if p_size == 1:  # no ring: one panel, no rotation/loop overhead
+            return m, l, acc
+
+        # ring steps as a fori_loop (matching the xla path): the unrolled
+        # form kept every rotated K/V panel alive simultaneously — ~2·p
+        # full panels of buffer liveness per chip, the dominant term in the
+        # per-chip HBM accounting at long context (AOT_MEMORY.json). The
+        # loop carry holds exactly one panel in flight.
+        def step(i, carry):
+            k_cur, v_cur, m, l, acc = carry
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
             owner = (idx - i) % p_size
-            k_next = jax.lax.ppermute(k_cur, axis, perm)
-            v_next = jax.lax.ppermute(v_cur, axis, perm)
-            m, l, acc = flash_attention_panel(
-                q_blk, k_cur, v_cur, m, l, acc,
-                idx * sq, owner * skv, valid_len,
-                causal=causal, scale=scale, bq=b, bkv=b)
-            k_cur, v_cur = k_next, v_next
+            m, l, acc = panel(q_blk, k_cur, v_cur, m, l, acc,
+                              idx * sq, owner * skv, valid_len)
+            return k_cur, v_cur, m, l, acc
+
+        _, _, m, l, acc = jax.lax.fori_loop(
+            1, p_size, step, (k_blk, v_blk, m, l, acc))
         return m, l, acc
 
     def local_flash(q_blk, k_blk, v_blk, valid_len):
@@ -145,26 +162,42 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
         do_f = do_blk.astype(jnp.float32)
         delta = jnp.sum(do_f * out_blk.astype(jnp.float32), axis=-1,
                         keepdims=True)
-        dq = jnp.zeros((sq, d), jnp.float32)
-        zeros_kv = jnp.zeros((skv, d), jnp.float32)
-        k_cur, v_cur = k_blk, v_blk
-        dk_cur = jax.lax.pcast(zeros_kv, (axis,), to="varying")
-        dv_cur = jax.lax.pcast(zeros_kv, (axis,), to="varying")
-        for i in range(p_size):
-            owner = (idx - i) % p_size
-            dq_p, dk_p, dv_p = flash_attention_panel_bwd(
-                q_blk, k_cur, v_cur, do_blk, lse_blk, delta,
-                idx * sq, owner * skv, valid_len,
-                causal=causal, scale=scale, bq=b, bkv=b)
-            dq = dq + dq_p
-            dk_cur = dk_cur + dk_p
-            dv_cur = dv_cur + dv_p
-            # rotate panels AND their gradient accumulators together: after
-            # p rotations every panel (and its dk/dv sum) is home
+        panel_bwd = functools.partial(flash_attention_panel_bwd, causal=causal,
+                                      scale=scale, bq=b, bkv=b)
+        # home panel first (i = 0), outside the loop: the K/V panels then
+        # rotate only p-1 times. The dK/dV accumulators DO permute after
+        # every accumulate, including the last — those p hops are what
+        # brings each panel's gradient sum home; only the K/V rotation on
+        # the final step was dead weight.
+        dq, dk_cur, dv_cur = panel_bwd(
+            q_blk, k_blk, v_blk, do_blk, lse_blk, delta,
+            idx * sq, idx * skv, valid_len)
+        if p_size == 1:  # no ring: single panel backward, nothing rotates
+            return dq, dk_cur, dv_cur
+        # (no pcast needed: the kernel outputs already carry the inputs' vma)
+        dk_cur = jax.lax.ppermute(dk_cur, axis, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis, perm)
+
+        # fori_loop for the same buffer-liveness reason as the forward: the
+        # unrolled form held p copies of the rotating panels AND their f32
+        # dK/dV accumulators at once
+        def step(i, carry):
+            k_cur, v_cur, dk_cur, dv_cur, dq = carry
             k_cur = jax.lax.ppermute(k_cur, axis, perm)
             v_cur = jax.lax.ppermute(v_cur, axis, perm)
-            dk_cur = jax.lax.ppermute(dk_cur, axis, perm)
-            dv_cur = jax.lax.ppermute(dv_cur, axis, perm)
+            owner = (idx - i) % p_size
+            dq_p, dk_p, dv_p = panel_bwd(
+                q_blk, k_cur, v_cur, do_blk, lse_blk, delta,
+                idx * sq, owner * skv, valid_len)
+            # rotate the accumulators WITH their panels: after p rotations
+            # every panel's dk/dv sum is home
+            return (k_cur, v_cur,
+                    jax.lax.ppermute(dk_cur + dk_p, axis, perm),
+                    jax.lax.ppermute(dv_cur + dv_p, axis, perm),
+                    dq + dq_p)
+
+        _, _, dk_cur, dv_cur, dq = jax.lax.fori_loop(
+            1, p_size, step, (k_blk, v_blk, dk_cur, dv_cur, dq))
         return dq, dk_cur, dv_cur
 
     def local(q_blk, k_blk, v_blk, valid_len):

@@ -100,22 +100,37 @@ def attn_forward(seq: int, mesh) -> dict:
     return out
 
 
+# bf16-only escalations past the f32 cliff: these run ONLY on the bf16 sweep
+# (their f32 compiles are known-doomed hour-long OOMs) and are part of the
+# default run so a plain `python tools/aot_report.py` regenerates every
+# number the docs cite.
+BF16_EXTRA_SEQS = [1572864, 2097152]
+
+_REPORT_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "AOT_MEMORY.json")
+
+
 def main(seqs):
     mesh = topology_mesh(("rows",), (1,))  # the single-chip bench shape
-    report = {
-        "topology": "v5e (compile-only, libtpu " + _libtpu_version() + ")",
-        "program": "lm_train_step d256/h2/l2/v512 remat+loss_chunk16k "
-                   "ring_flash (= bench_all config_lct_long) and the "
-                   "ring-flash causal forward at d=128 (= config_attn_long)",
-        "lct_long": {},
-        "lct_long_bf16": {},
-        "attn_long": {},
-    }
+    # merge-update: a partial rerun (subset of seqs) must refresh its rows
+    # without dropping the rest of the committed evidence
+    try:
+        with open(_REPORT_PATH) as f:
+            report = json.load(f)
+    except (FileNotFoundError, ValueError):
+        report = {}
+    report["topology"] = "v5e (compile-only, libtpu " + _libtpu_version() + ")"
+    report["program"] = (
+        "lm_train_step d256/h2/l2/v512 remat+loss_chunk16k "
+        "ring_flash (= bench_all config_lct_long) and the "
+        "ring-flash causal forward at d=128 (= config_attn_long)")
+    for sec in ("lct_long", "lct_long_bf16", "attn_long", "lct_long_4chip"):
+        report.setdefault(sec, {})
     for seq in seqs:
         print(f"[aot] lct_long seq={seq} ...", flush=True)
         report["lct_long"][str(seq)] = r = _try(lct_train_step, seq, mesh)
         print(f"  {_fmt(r)}", flush=True)
-    for seq in seqs:
+    for seq in list(seqs) + BF16_EXTRA_SEQS:
         print(f"[aot] lct_long_bf16 seq={seq} ...", flush=True)
         report["lct_long_bf16"][str(seq)] = r = _try(
             lambda s, m: lct_train_step(s, m, compute_dtype="bfloat16"),
@@ -125,8 +140,18 @@ def main(seqs):
         print(f"[aot] attn_long seq={seq} ...", flush=True)
         report["attn_long"][str(seq)] = r = _try(attn_forward, seq, mesh)
         print(f"  {_fmt(r)}", flush=True)
-    with open(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "AOT_MEMORY.json"), "w") as f:
+    # multi-chip: the budget table's "p chips train p× the context at the
+    # same per-chip residency" claim, compiler-verified on a real 4-chip v5e
+    # topology (ring over ICI). memory_analysis is per device.
+    mesh4 = topology_mesh(("rows",), (4,), topology_name="v5e:2x2")
+    for seq, cd in ((4 * seqs[-1], "bfloat16"), (seqs[-1], None)):
+        label = f"{seq}{'_bf16' if cd else ''}"
+        print(f"[aot] lct_long_4chip {label} ...", flush=True)
+        report["lct_long_4chip"][label] = r = _try(
+            lambda s, m: lct_train_step(s, m, compute_dtype=cd), seq, mesh4)
+        print(f"  {_fmt(r)} (per chip)", flush=True)
+
+    with open(_REPORT_PATH, "w") as f:
         json.dump(report, f, indent=2)
     print("wrote AOT_MEMORY.json")
 

@@ -219,24 +219,32 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
             return softmax_tile_update(q_blk, k_t, v_t, m, l, acc,
                                        q_pos, k_pos, valid_len, causal, scale)
 
-        def step(i, carry):
-            k_cur, v_cur, m, l, acc = carry
-            owner = (idx - i) % p_size
-            k_next = jax.lax.ppermute(k_cur, axis, perm)
-            v_next = jax.lax.ppermute(v_cur, axis, perm)
-            m, l, acc = jax.lax.fori_loop(
+        def panel_tiles(carry, k_cur, v_cur, owner):
+            return jax.lax.fori_loop(
                 0, n_tiles,
                 lambda t, c: accumulate_tile(t, c, k_cur, v_cur, owner),
-                (m, l, acc),
+                carry,
             )
-            return k_next, v_next, m, l, acc
 
-        m0 = jax.lax.pcast(jnp.full((sq,), _NEG, jnp.float32), (axis,), to="varying")
-        l0 = jax.lax.pcast(jnp.zeros((sq,), jnp.float32), (axis,), to="varying")
-        acc0 = jax.lax.pcast(jnp.zeros((sq, d), jnp.float32), (axis,), to="varying")
-        _, _, m, l, acc = jax.lax.fori_loop(
-            0, p_size, step, (k_blk, v_blk, m0, l0, acc0)
-        )
+        m0 = _var(jnp.full((sq,), _NEG, jnp.float32))
+        l0 = _var(jnp.zeros((sq,), jnp.float32))
+        acc0 = _var(jnp.zeros((sq, d), jnp.float32))
+        # home panel outside the loop; the ring rotates p-1 times and never
+        # ships a dead final panel (same structure as the flash path)
+        m, l, acc = panel_tiles((m0, l0, acc0), k_blk, v_blk, idx)
+
+        def step(i, carry):
+            k_cur, v_cur, m, l, acc = carry
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+            owner = (idx - i) % p_size
+            m, l, acc = panel_tiles((m, l, acc), k_cur, v_cur, owner)
+            return k_cur, v_cur, m, l, acc
+
+        if p_size > 1:
+            _, _, m, l, acc = jax.lax.fori_loop(
+                1, p_size, step, (k_blk, v_blk, m, l, acc)
+            )
         return (acc / jnp.maximum(l, 1e-30)[:, None]).astype(q_blk.dtype)
 
     def shard_mapped(fn, check_vma):

@@ -74,7 +74,34 @@ def _rmsnorm(x, g):
 _ATTN_BACKENDS = {"ring": "auto", "ring_flash": "flash", "ring_xla": "xla"}
 
 
-def _block(lp, x, heads: int, mesh, attn: str, precision: str):
+def _mlp(h, w1, w2, chunk: int | None):
+    """The position-wise FFN, optionally scanned over ``chunk``-token slices
+    with per-slice rematerialization. The (seq, d_ff) GELU intermediate is
+    the single largest activation in the block (d_ff = 4d); chunking caps it
+    at (chunk, d_ff) — the :func:`_chunked_nll` trick applied to the FFN
+    (compiler-measured: ~0.9 GiB off the 1M-token f32 step at d_ff=1024;
+    grows with d_ff). Positions are independent, so slicing is exact."""
+    if chunk is not None and chunk < 1:
+        raise ValueError(f"mlp_chunk must be >= 1 or None, got {chunk}")
+    if chunk is None or h.shape[0] <= chunk:
+        return jax.nn.gelu(h @ w1) @ w2
+
+    def one(hc):
+        return jax.nn.gelu(hc @ w1) @ w2
+
+    seq, d = h.shape
+    n_full = seq // chunk
+    head = h[: n_full * chunk].reshape(n_full, chunk, d)
+    body = jax.checkpoint(lambda _, hc: (None, one(hc)))
+    _, out = jax.lax.scan(body, None, head)
+    out = out.reshape(n_full * chunk, d)
+    if seq % chunk:
+        out = jnp.concatenate([out, one(h[n_full * chunk:])])
+    return out
+
+
+def _block(lp, x, heads: int, mesh, attn: str, precision: str,
+           mlp_chunk: int | None = None):
     # No explicit sequence-sharding constraints here: XLA's sharding
     # propagation from the ring's internal placements already shards the
     # residual stream and projections over the mesh rows axis (verified by
@@ -101,13 +128,14 @@ def _block(lp, x, heads: int, mesh, attn: str, precision: str):
     o = o.transpose(1, 0, 2).reshape(seq, d).astype(cd) @ lp["wo"].astype(cd)
     x = x + o
     h = _rmsnorm(x, lp["ln2"])
-    return x + jax.nn.gelu(h @ lp["w1"].astype(cd)) @ lp["w2"].astype(cd)
+    return x + _mlp(h, lp["w1"].astype(cd), lp["w2"].astype(cd), mlp_chunk)
 
 
 def transformer_forward(params: dict, tokens, mesh=None, heads: int = 4,
                         attn: str = "ring", remat: bool = False,
                         precision: str = "high",
-                        compute_dtype: str | None = None):
+                        compute_dtype: str | None = None,
+                        mlp_chunk: int | None = None):
     """Logits for next-token prediction; ``tokens`` is a (seq,) int array.
     ``attn``: "ring" (sequence rotates K/V panels; backend auto-picked),
     "ring_flash" / "ring_xla" (ring with the backend pinned), or "ulysses"
@@ -118,7 +146,7 @@ def transformer_forward(params: dict, tokens, mesh=None, heads: int = 4,
     the long-context HBM budget (activations dominate it; see
     docs/parallelism.md) and the bf16-MXU speed path."""
     x = _trunk(params, tokens, mesh, heads, attn, remat, precision,
-               compute_dtype)
+               compute_dtype, mlp_chunk)
     return _head_logits(x, params["emb"])
 
 
@@ -131,7 +159,7 @@ def _head_logits(x, emb):
 
 
 def _trunk(params, tokens, mesh, heads, attn, remat, precision,
-           compute_dtype=None):
+           compute_dtype=None, mlp_chunk=None):
     """Final-rmsnorm hidden states, (seq, d_model) — the forward minus the
     LM head projection. With ``compute_dtype``, the residual stream and every
     matmul operand are cast to it (norm statistics and softmax stay f32
@@ -148,7 +176,7 @@ def _trunk(params, tokens, mesh, heads, attn, remat, precision,
     n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
     for i in range(n_layers):
         blk = functools.partial(_block, heads=heads, mesh=mesh, attn=attn,
-                                precision=precision)
+                                precision=precision, mlp_chunk=mlp_chunk)
         blk = jax.checkpoint(blk) if remat else blk
         x = blk(params[f"l{i}"], x)
     return _rmsnorm(x, params["ln_f"])
@@ -182,7 +210,8 @@ def _chunked_nll(x, emb, targets, chunk: int):
 
 def lm_loss(params, tokens, mesh=None, heads: int = 4, attn: str = "ring",
             remat: bool = False, precision: str = "high",
-            loss_chunk: int | None = None, compute_dtype: str | None = None):
+            loss_chunk: int | None = None, compute_dtype: str | None = None,
+            mlp_chunk: int | None = None):
     """Mean next-token cross-entropy over the sequence. ``loss_chunk`` scans
     the LM head over that many tokens at a time (see :func:`_chunked_nll`) —
     the long-context memory knob companion to ``remat``. ``compute_dtype``
@@ -190,23 +219,25 @@ def lm_loss(params, tokens, mesh=None, heads: int = 4, attn: str = "ring",
     tgt = jnp.asarray(tokens[1:])
     if loss_chunk is None:
         logits = transformer_forward(params, tokens[:-1], mesh, heads, attn,
-                                     remat, precision, compute_dtype)
+                                     remat, precision, compute_dtype,
+                                     mlp_chunk)
         logp = jax.nn.log_softmax(logits, axis=-1)
         return -jnp.mean(jnp.take_along_axis(logp, tgt[:, None], axis=1))
     if loss_chunk < 1:
         raise ValueError(f"loss_chunk must be >= 1 or None, got {loss_chunk}")
     x = _trunk(params, tokens[:-1], mesh, heads, attn, remat, precision,
-               compute_dtype)
+               compute_dtype, mlp_chunk)
     return _chunked_nll(x, params["emb"], tgt, loss_chunk) / tgt.shape[0]
 
 
 @functools.partial(jax.jit, static_argnames=(
     "mesh", "heads", "attn", "remat", "precision", "lr", "loss_chunk",
-    "compute_dtype"))
+    "compute_dtype", "mlp_chunk"))
 def lm_train_step(params, opt_state, tokens, mesh, heads: int, attn: str,
                   remat: bool, precision: str, lr: float,
                   loss_chunk: int | None = None,
-                  compute_dtype: str | None = None):
+                  compute_dtype: str | None = None,
+                  mlp_chunk: int | None = None):
     """One Adam step, jitted at module level with static config primitives so
     repeated ``train()`` calls (and the bench's warm-up-then-time discipline)
     hit one compiled program — the same cache pattern as
@@ -215,7 +246,7 @@ def lm_train_step(params, opt_state, tokens, mesh, heads: int, attn: str,
 
     loss, grads = jax.value_and_grad(
         lambda p: lm_loss(p, tokens, mesh, heads, attn, remat, precision,
-                          loss_chunk, compute_dtype)
+                          loss_chunk, compute_dtype, mlp_chunk)
     )(params)
     updates, opt_state = optax.adam(lr).update(grads, opt_state, params)
     return optax.apply_updates(params, updates), opt_state, loss
@@ -365,6 +396,10 @@ class TransformerLM:
     # precision); with remat+loss_chunk this is what fits 1M tokens on one
     # 16 GB v5e (AOT_MEMORY.json)
     compute_dtype: str | None = None
+    # scan the FFN over this many tokens at a time: caps the (seq, d_ff)
+    # GELU intermediate at (chunk, d_ff) — worth ~GiBs at 1M+ tokens, more
+    # at larger d_ff
+    mlp_chunk: int | None = None
 
     def init_params(self, dtype=jnp.float32) -> dict:
         return init_transformer(jax.random.key(self.seed), self.vocab,
@@ -391,7 +426,7 @@ class TransformerLM:
             params, opt_state, loss = lm_train_step(
                 params, opt_state, tokens, mesh, self.heads, self.attn,
                 self.remat, self.precision, self.learning_rate,
-                self.loss_chunk, self.compute_dtype,
+                self.loss_chunk, self.compute_dtype, self.mlp_chunk,
             )
             losses.append(float(loss))
             if log_every and (it + 1) % log_every == 0:

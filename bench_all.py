@@ -357,7 +357,7 @@ def config_nn(m=262_144, d=784, hidden=1024, classes=10, batch=8192,
 
 def config_lct(seq=32768, d_model=256, heads=2, layers=2, steps=3,
                remat=False, loss_chunk=None, name=None, attn="ring",
-               compute_dtype=None):
+               compute_dtype=None, mlp_chunk=None):
     """Long-context LM training throughput: one 32k-token causal stream,
     flash ring attention (dh=128 -> MXU tiles), Adam, full backward through
     the sequence-parallel attention (recompute VJP). No reference analog —
@@ -373,7 +373,8 @@ def config_lct(seq=32768, d_model=256, heads=2, layers=2, steps=3,
     tokens = rng.integers(0, vocab, seq).astype(np.int32)
     lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
                        layers=layers, attn=attn, remat=remat,
-                       loss_chunk=loss_chunk, compute_dtype=compute_dtype)
+                       loss_chunk=loss_chunk, compute_dtype=compute_dtype,
+                       mlp_chunk=mlp_chunk)
     params, _ = lm.train(tokens, steps=1, mesh=mesh)  # compile
     t0 = time.perf_counter()
     params, losses = lm.train(tokens, steps=steps, mesh=mesh, params=params)
@@ -412,10 +413,11 @@ def config_lct_long():
     # MARLIN_BENCH_LCT_DTYPE=bfloat16 selects the mixed-precision path —
     # REQUIRED at 1M tokens (f32 needs 22 GiB; bf16 fits — AOT_MEMORY.json)
     cd = os.environ.get("MARLIN_BENCH_LCT_DTYPE") or None
+    mc = int(os.environ.get("MARLIN_BENCH_LCT_MLP_CHUNK", 0)) or None
     suffix = f"_{cd}" if cd else ""
     config_lct(seq=seq, steps=2, remat=True, loss_chunk=16384,
                name=f"lct_long_{seq}tok_d256_h2_l2{suffix}",
-               attn="ring_flash", compute_dtype=cd)
+               attn="ring_flash", compute_dtype=cd, mlp_chunk=mc)
 
 
 def config_decode(d_model=512, heads=8, layers=4, vocab=4096,

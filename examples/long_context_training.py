@@ -4,9 +4,11 @@ sequence sharded over the mesh through ring or ulysses attention, trained
 with Adam. Prints the loss trajectory and tokens/s.
 
 args: ``<seq len> <steps> [d_model] [heads] [layers] [ring|ulysses] [remat 0|1]
-[loss_chunk]`` — ``loss_chunk`` scans the LM head (the 256k+-tokens-per-chip
-knob, docs/parallelism.md); after training, a greedy ``lm_generate`` sample
-continues the stream from a short prompt.
+[loss_chunk] [dtype]`` — ``loss_chunk`` scans the LM head and ``dtype``
+(``bfloat16``) selects mixed-precision activations; together with ``remat``
+these are the knobs that carry 1M+ tokens on one chip (docs/parallelism.md);
+after training, a greedy ``lm_generate`` sample continues the stream from a
+short prompt.
 """
 
 import sys
@@ -18,7 +20,7 @@ def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) < 2:
         die("usage: long_context_training <seq len> <steps> [d_model] [heads] "
-            "[layers] [ring|ulysses] [remat 0|1] [loss_chunk]")
+            "[layers] [ring|ulysses] [remat 0|1] [loss_chunk] [dtype]")
     seq = int(argv[0])
     steps = int(argv[1])
     d_model = int(argv[2]) if len(argv) > 2 else 128
@@ -27,6 +29,7 @@ def main(argv=None):
     attn = argv[5] if len(argv) > 5 else "ring"
     remat = bool(int(argv[6])) if len(argv) > 6 else False
     loss_chunk = int(argv[7]) if len(argv) > 7 else None
+    compute_dtype = argv[8] if len(argv) > 8 else None
 
     import marlin_tpu as mt
     from marlin_tpu.models import TransformerLM
@@ -38,7 +41,7 @@ def main(argv=None):
 
     lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
                        layers=layers, attn=attn, remat=remat,
-                       loss_chunk=loss_chunk)
+                       loss_chunk=loss_chunk, compute_dtype=compute_dtype)
     lm.train(tokens, steps=1, mesh=mesh)  # compile (module-level jit cache)
     t0 = millis()
     params, losses = lm.train(tokens, steps=steps, mesh=mesh)
@@ -46,7 +49,8 @@ def main(argv=None):
     tok_s = seq * steps / (dt / 1e3)
     print(f"seq={seq} d={d_model} heads={heads} layers={layers} {attn}"
           f"{' remat' if remat else ''}"
-          f"{f' loss_chunk={loss_chunk}' if loss_chunk else ''}: "
+          f"{f' loss_chunk={loss_chunk}' if loss_chunk else ''}"
+          f"{f' {compute_dtype}' if compute_dtype else ''}: "
           f"loss {losses[0]:.3f} -> "
           f"{losses[-1]:.3f} in {dt:.0f} millis ({tok_s / 1e3:.1f}k tok/s)")
 

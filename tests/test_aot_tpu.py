@@ -21,13 +21,19 @@ pytestmark = pytest.mark.skipif(
     not supports_aot_tpu(), reason="libtpu compile-only topology unavailable")
 
 
-def _compile1(fn, arg_shapes):
-    """AOT-compile ``fn`` for one topology device, fully replicated."""
+def _one_device_sharding():
+    """The canonical single-device AOT placement (replicated on one topo
+    chip) — shared by every single-chip compile test."""
     from jax.sharding import Mesh
 
     topo = tpu_topology()
     mesh = Mesh(np.array([topo.devices[0]]).reshape(1, 1), ("a", "b"))
-    rep = NamedSharding(mesh, P())
+    return NamedSharding(mesh, P())
+
+
+def _compile1(fn, arg_shapes):
+    """AOT-compile ``fn`` for one topology device, fully replicated."""
+    rep = _one_device_sharding()
     args = [jax.ShapeDtypeStruct(s, jnp.float32) for s in arg_shapes]
     return jax.jit(fn, in_shardings=rep, out_shardings=rep) \
         .trace(*args).lower().compile()
@@ -148,3 +154,22 @@ def test_distributed_engines_compile_for_8chip_v5e():
     with mt.config_context(pallas_interpret=False):
         jax.jit(lambda q, k, v: ulysses_attention(q, k, v, meshr, causal=True)) \
             .trace(h, h, h).lower().compile()
+
+
+def test_decode_path_compiles_for_v5e():
+    """lm_generate (batched prefill + scan decode + traced temperature)
+    AOT-compiled for a v5e device — the decode bench's program is proven
+    before it ever reaches the chip."""
+    from marlin_tpu.models.transformer import TransformerLM, lm_generate
+
+    rep = _one_device_sharding()
+    lm = TransformerLM(vocab=4096, d_model=512, heads=8, layers=4, seed=0)
+    params = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype, sharding=rep),
+        jax.eval_shape(lm.init_params))
+    prompt = jax.ShapeDtypeStruct((512,), jnp.int32, sharding=rep)
+    key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype, sharding=rep)
+    temp = jax.ShapeDtypeStruct((), jnp.float32, sharding=rep)
+    c = lm_generate.trace(params, prompt, key, heads=8, max_len=832,
+                          steps=320, temperature=temp).lower().compile()
+    assert c.memory_analysis().peak_memory_in_bytes < 2 * 1024**3

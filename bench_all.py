@@ -628,8 +628,9 @@ def main():
                 "|---|---|---|---|---|\n")
         for r in ordered:
             # entries from before the provenance stamp are round-2-or-earlier
-            # by definition (the stamp shipped in round 4)
-            when = r.get("measured", "≤r3 (pre-provenance; stale)")
+            # by definition (the stamp shipped in round 4; the relay was down
+            # for all of round 3)
+            when = r.get("measured", "≤r2 (pre-provenance; stale)")
             f.write(f"| {r['config']} | {r['value']} | {r['unit']} | {when} "
                     f"| {r['detail']} |\n")
 

@@ -291,20 +291,64 @@ def _decode_step(params, x, caches, pos, heads: int):
     return _head_logits(x, params["emb"]), new_caches
 
 
+# Prompts at/above this length prefill through the flash kernel instead of
+# the dense (heads, P, P) score einsum. 2048 keeps short prompts on the
+# cheaper dense path (the score tensor is a few MB) while bounding score
+# memory before the quadratic term matters; at the threshold the dense path
+# holds heads x 2048² f32 scores (~32 MB at 2 heads) vs flash's VMEM tiles.
+_PREFILL_FLASH_MIN = 2048
+
+
+def _prefill_attn(q, k, v, cdtype):
+    """Causal self-attention over the whole prompt, (P, heads, dh) -> same.
+
+    Short prompts use one batched einsum — the (heads, P, P) f32 score tensor
+    is small and XLA fuses the mask/softmax into it. Past
+    :data:`_PREFILL_FLASH_MIN` that tensor is quadratic in the prompt (the
+    round-4 advisor finding: a long document would OOM at prefill while the
+    same length *trains* fine), so the prompt routes through the flash panel
+    kernel vmapped over heads — score tiles never leave VMEM and prefill peak
+    HBM is linear in P (compiler-asserted in tests/test_aot_tpu.py)."""
+    P, heads, dh = q.shape
+    scale = 1.0 / math.sqrt(dh)
+    if P < _PREFILL_FLASH_MIN:
+        causal = jnp.tril(jnp.ones((P, P), bool))
+        s = jnp.einsum("phd,thd->hpt", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(causal[None], s, -1e30)
+        return jnp.einsum("hpt,thd->phd",
+                          jax.nn.softmax(s, axis=-1).astype(cdtype), v)
+
+    from ..mesh import pad_to_multiple
+    from ..ops.flash_attention import flash_attention_single_panel
+
+    pp = pad_to_multiple(P, 128)  # Mosaic f32 tile; valid_len masks the pad
+    pad = [(0, pp - P), (0, 0)]
+
+    def one_head(qh, kh, vh):
+        out, _ = flash_attention_single_panel(
+            jnp.pad(qh, pad), jnp.pad(kh, pad), jnp.pad(vh, pad), P,
+            causal=True, scale=scale)
+        return out
+
+    o = jax.vmap(one_head)(*(jnp.moveaxis(t, 1, 0) for t in (q, k, v)))
+    return jnp.moveaxis(o[:, :P], 0, 1).astype(cdtype)
+
+
 def _prefill(params, prompt, heads: int, max_len: int, cdtype):
     """Process the whole prompt in ONE parallel forward — every projection is
-    a (P, d) @ (d, d) MXU matmul and the causal attention is one batched
-    einsum — returning the final-position logits plus per-layer KV caches
-    (in ``cdtype``) padded to ``max_len``. This is the standard
-    prefill/decode split: the scan in :func:`lm_generate` then runs only for
-    *generated* tokens (the previous formulation decoded the prompt
+    a (P, d) @ (d, d) MXU matmul and the causal attention is batched (dense
+    for short prompts, the flash kernel past :data:`_PREFILL_FLASH_MIN` — see
+    :func:`_prefill_attn`) — returning the final-position logits plus
+    per-layer KV caches (in ``cdtype``) padded to ``max_len``. This is the
+    standard prefill/decode split: the scan in :func:`lm_generate` then runs
+    only for *generated* tokens (the previous formulation decoded the prompt
     position-by-position, P sequential cache updates that no batch dimension
     could amortize)."""
     n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
     P = prompt.shape[0]
     d = params["emb"].shape[1]
     dh = d // heads
-    causal = jnp.tril(jnp.ones((P, P), bool))
     x = params["emb"][prompt].astype(cdtype)
     caches = {}
     for i in range(n_layers):
@@ -312,11 +356,7 @@ def _prefill(params, prompt, heads: int, max_len: int, cdtype):
         h = _rmsnorm(x, lp["ln1"])
         q, k, v = (jnp.reshape(h @ lp[w].astype(cdtype), (P, heads, dh))
                    for w in ("wq", "wk", "wv"))
-        s = jnp.einsum("phd,thd->hpt", q, k,
-                       preferred_element_type=jnp.float32) / math.sqrt(dh)
-        s = jnp.where(causal[None], s, -1e30)
-        o = jnp.einsum("hpt,thd->phd",
-                       jax.nn.softmax(s, axis=-1).astype(cdtype), v)
+        o = _prefill_attn(q, k, v, cdtype)
         x = x + o.reshape(P, d) @ lp["wo"].astype(cdtype)
         h = _rmsnorm(x, lp["ln2"])
         x = x + jax.nn.gelu(h @ lp["w1"].astype(cdtype)) @ lp["w2"].astype(cdtype)

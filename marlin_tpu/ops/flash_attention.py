@@ -31,7 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 from .pallas_kernels import _interpret
 
 __all__ = ["flash_attention_panel", "flash_attention_panel_bwd",
-           "block_divisor"]
+           "flash_attention_single_panel", "block_divisor"]
 
 _NEG = -1e30
 
@@ -350,3 +350,25 @@ def flash_attention_panel(q, k, v, m, l, acc, q_offset, k_offset, valid_len,
         interpret=interpret,
     )(scalars, q, k, v, m, l, acc)
     return m2, l2, a2
+
+
+def flash_attention_single_panel(q, k, v, valid_len, *, causal: bool,
+                                 scale: float):
+    """Full-sequence attention for one head as ONE flash panel: init the
+    (m, l, acc) state, a single :func:`flash_attention_panel` pass over all
+    keys, then normalize. Returns ``(out, lse)`` with ``out`` in f32 (callers
+    cast) and ``lse = m + log l`` for custom-vjp backwards.
+
+    The shared single-panel idiom of ulysses local attention
+    (parallel/ulysses.py) and the decode flash prefill
+    (models/transformer.py) — one home for the state-init/normalize contract
+    (the ``_NEG`` sentinel and the 1e-30 denominator floor)."""
+    seq, d = q.shape
+    b = block_divisor(seq)
+    m = jnp.full((seq, 1), _NEG, jnp.float32)
+    l = jnp.zeros((seq, 1), jnp.float32)
+    acc = jnp.zeros((seq, d), jnp.float32)
+    m, l, acc = flash_attention_panel(q, k, v, m, l, acc, 0, 0, valid_len,
+                                      causal=causal, scale=scale, bq=b, bkv=b)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return acc / jnp.maximum(l, 1e-30), lse

@@ -180,13 +180,20 @@ def _is_tracing(*arrays) -> bool:
     closed-over concrete operands still become tracers the moment an op
     touches them, so the host route must go through pure_callback then too.
 
-    The inside-a-trace check uses only public API: under omnistaging, any op
+    The inside-a-trace check prefers ``jax.core.trace_state_clean`` (cheap,
+    no device op) and falls back to a probe op — under omnistaging, any op
     executed while a trace is active yields a ``Tracer`` even on concrete
-    operands, so a probe op tells us directly (no dependence on private
-    ``jax._src`` trace-state helpers, which have moved before)."""
+    operands — where that helper is absent (removed on current JAX; the
+    lookup is hoisted to import time so eager calls pay no per-call
+    try/except, ADVICE r4)."""
     if any(isinstance(x, jax.core.Tracer) for x in arrays):
         return True
+    if _TRACE_STATE_CLEAN is not None:
+        return not _TRACE_STATE_CLEAN()
     return isinstance(jnp.zeros(()) + 0, jax.core.Tracer)
+
+
+_TRACE_STATE_CLEAN = getattr(jax.core, "trace_state_clean", None)
 
 
 def mult_sparse_sparse_bound(a, b) -> int:

@@ -39,19 +39,11 @@ _NEG = -1e30
 
 
 def _flash_fwd_impl(q, k, v, valid_len, causal: bool, scale: float):
-    from ..ops.flash_attention import block_divisor, flash_attention_panel
+    from ..ops.flash_attention import flash_attention_single_panel
 
-    seq, d = q.shape
-    b = block_divisor(seq)
-    m = jnp.full((seq, 1), _NEG, jnp.float32)
-    l = jnp.zeros((seq, 1), jnp.float32)
-    acc = jnp.zeros((seq, d), jnp.float32)
-    m, l, acc = flash_attention_panel(
-        q, k, v, m, l, acc, 0, 0, valid_len,
-        causal=causal, scale=scale, bq=b, bkv=b,
-    )
-    lse = m + jnp.log(jnp.maximum(l, 1e-30))
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype), lse
+    out, lse = flash_attention_single_panel(q, k, v, valid_len,
+                                            causal=causal, scale=scale)
+    return out.astype(q.dtype), lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))

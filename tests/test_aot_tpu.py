@@ -189,3 +189,33 @@ def test_pallas_matmul_and_masked_fill_mosaic_compile():
         x = jax.ShapeDtypeStruct((256, 256), jnp.float32)
         jax.jit(lambda x: masked_fill(x, 200, 190), in_shardings=rep,
                 out_shardings=rep).trace(x).lower().compile()
+
+
+def test_flash_prefill_memory_linear_on_tpu():
+    """Decode prefill past _PREFILL_FLASH_MIN runs the flash kernel, so the
+    prompt's score memory never materializes: TPU-compiler peak for the whole
+    lm_generate program must grow ~linearly from 8k to 16k prompts (the dense
+    path it replaced held heads x P² f32 scores per layer — 2.1 -> 8.6 GiB
+    quadratic growth at these shapes; ADVICE r4 / round-4 verdict #3)."""
+    from marlin_tpu.models.transformer import TransformerLM, lm_generate
+
+    rep = _one_device_sharding()
+    lm = TransformerLM(vocab=4096, d_model=512, heads=8, layers=4, seed=0)
+    params = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype, sharding=rep),
+        jax.eval_shape(lm.init_params))
+    key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype, sharding=rep)
+    temp = jax.ShapeDtypeStruct((), jnp.float32, sharding=rep)
+
+    def peak(plen):
+        prompt = jax.ShapeDtypeStruct((plen,), jnp.int32, sharding=rep)
+        with mt.config_context(pallas_interpret=False):
+            c = lm_generate.trace(params, prompt, key, heads=8,
+                                  max_len=plen + 16, steps=16,
+                                  temperature=temp).lower().compile()
+        return c.memory_analysis().peak_memory_in_bytes
+
+    p8, p16 = peak(8192), peak(16384)
+    assert p16 < 2.6 * p8, (p8, p16)
+    # and nowhere near the dense path's 8.6 GiB of scores
+    assert p16 < 2 * 1024**3, p16

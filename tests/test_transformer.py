@@ -189,11 +189,14 @@ def test_lm_generate_no_recompile_across_temperatures(mesh):
     prompt = np.array([1, 2, 3], np.int32)
     lm_generate(p, prompt, jax.random.key(0), heads=2, max_len=16, steps=4,
                 temperature=0.0)
-    n0 = lm_generate._cache_size()
+    cache_size = getattr(lm_generate, "_cache_size", None)
+    if cache_size is None:  # private jitted-fn API; absent on newer JAX
+        pytest.skip("jit cache-size probe unavailable on this JAX")
+    n0 = cache_size()
     outs = [np.asarray(lm_generate(p, prompt, jax.random.key(0), heads=2,
                                    max_len=16, steps=4, temperature=t))
             for t in (0.0, 0.5, 1.0, 2.0)]
-    assert lm_generate._cache_size() == n0, "temperature sweep recompiled"
+    assert cache_size() == n0, "temperature sweep recompiled"
     # temperature=0 via the traced path still equals greedy
     assert outs[0].shape == (7,)
 
@@ -304,3 +307,52 @@ def test_mlp_chunk_validation(mesh):
     p = lm.init_params()
     with pytest.raises(ValueError, match="mlp_chunk"):
         lm_loss(p, _tokens(33, vocab=16), mesh, heads=2, mlp_chunk=0)
+
+
+def test_flash_prefill_matches_dense(mesh, monkeypatch):
+    """Past _PREFILL_FLASH_MIN the prefill attention routes through the flash
+    panel kernel (linear-memory — the round-4 advisor finding killed the
+    O(P²) score tensor). Same math: logits and KV caches must match the dense
+    einsum path, including when the prompt needs padding to the Mosaic tile."""
+    import jax
+
+    from marlin_tpu.models import transformer as T
+
+    lm = TransformerLM(vocab=32, d_model=16, heads=2, layers=2, seed=7)
+    p = lm.init_params()
+    for plen in (100, 64):  # 100 -> padded to 128; 64 -> exact-divisor path
+        prompt = jnp.asarray(_tokens(plen, vocab=32), jnp.int32)
+        dense_logits, dense_caches = T._prefill(p, prompt, 2, plen + 8,
+                                                jnp.float32)
+        monkeypatch.setattr(T, "_PREFILL_FLASH_MIN", 16)
+        flash_logits, flash_caches = T._prefill(p, prompt, 2, plen + 8,
+                                                jnp.float32)
+        monkeypatch.undo()
+        np.testing.assert_allclose(np.asarray(flash_logits),
+                                   np.asarray(dense_logits),
+                                   rtol=2e-4, atol=1e-5)
+        for layer in dense_caches:
+            for a, b in zip(dense_caches[layer], flash_caches[layer]):
+                np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                           rtol=2e-4, atol=1e-6)
+
+
+def test_flash_prefill_generates(mesh, monkeypatch):
+    """End-to-end greedy decode through the flash prefill equals the dense
+    oracle (full uncached forward re-argmaxed per position)."""
+    import jax
+
+    from marlin_tpu.models import transformer as T
+
+    monkeypatch.setattr(T, "_PREFILL_FLASH_MIN", 8)
+    lm = TransformerLM(vocab=32, d_model=16, heads=2, layers=1, seed=8)
+    p = lm.init_params()
+    prompt = np.array([5, 1, 9, 2, 7, 0, 11, 3, 2, 1], np.int32)  # P=10 > 8
+    steps = 4
+    out = np.asarray(lm_generate(p, prompt, jax.random.key(0), heads=2,
+                                 max_len=24, steps=steps))
+    cur = prompt.tolist()
+    for _ in range(steps):
+        logits = transformer_forward(p, np.array(cur, np.int32), mesh, heads=2)
+        cur.append(int(np.argmax(np.asarray(logits[-1]))))
+    assert out.tolist() == cur

@@ -1,12 +1,13 @@
 #!/bin/bash
-# TPU-relay recovery runner (round 4).
+# TPU-relay recovery runner (round 5).
 #
 # The relay wedged at round end in rounds 1 AND 2, so the driver-captured
-# bench was 0.0 twice. This script converts relay uptime into measurements
-# the moment it appears: probe patiently (never killing a client — a SIGKILL
-# mid-claim wedges the lease for hours), and on the first successful device
-# enumeration run the measurement batch, most-critical-first, so a re-wedge
-# mid-batch costs the least important numbers.
+# bench was 0.0 four times. This script converts relay uptime into
+# measurements the moment it appears: probe patiently (never killing a
+# client — a SIGKILL mid-claim wedges the lease for hours), and on the first
+# successful device enumeration run the measurement batch,
+# most-critical-first, so a re-wedge mid-batch costs the least important
+# numbers.
 #
 # Discipline (see ROADMAP.md environment caveats):
 #   - one TPU client at a time (waits for any in-flight probe first)
@@ -14,7 +15,7 @@
 #   - no concurrent heavy CPU work while a TPU process runs
 set -u
 cd "$(dirname "$0")/.."
-LOG=/tmp/r4_recovery_runner.log
+LOG=/tmp/r5_recovery_runner.log
 exec >>"$LOG" 2>&1
 
 ts() { date -u +%H:%M:%S; }
@@ -32,8 +33,11 @@ ts() { date -u +%H:%M:%S; }
 # Both matchers exclude the build driver, whose command line embeds a prompt
 # containing these very file names.
 tpu_clients() {
-  pgrep -af "import jax|bench\.py|bench_all\.py|tpu_smoke" 2>/dev/null \
-    | grep -v "claude -p" | grep -v "r4_probe" | grep -q .
+  # hbm_probe IS a claiming client (it inits the backend); orphaned probes
+  # from killed runner loops are too — only the build driver is excluded
+  # (its cmdline embeds these very file names inside its prompt).
+  pgrep -af "import jax|bench\.py|bench_all\.py|tpu_smoke|hbm_probe" \
+    2>/dev/null | grep -v "claude -p" | grep -q .
 }
 cpu_load() {
   pgrep -af "pytest" 2>/dev/null | grep -v "claude -p" | grep -q .
@@ -45,7 +49,7 @@ while true; do
     sleep 60
   done
   echo "$(ts) probing"
-  out=$(python -c "import jax; d = jax.devices(); print('NDEV', len(d), d[0].platform)" 2>&1 | tail -1)
+  out=$(python -c "import jax; d = jax.devices(); print('NDEV', len(d), d[0].platform)" 2>&1 | grep -E "NDEV|Error" | tail -1)
   echo "$(ts) probe: $out"
   # require a non-CPU platform: a CPU-fallback init must NOT start the batch
   case "$out" in
@@ -55,7 +59,7 @@ while true; do
   sleep 180
 done
 
-export MARLIN_BENCH_ROUND=r4  # provenance label for every bench_all entry
+export MARLIN_BENCH_ROUND=r5  # provenance label for every bench_all entry
 echo "$(ts) RECOVERED — relay is alive"
 while cpu_load; do
   echo "$(ts) deferring measurement batch: heavy CPU load (pytest) running"
@@ -63,13 +67,13 @@ while cpu_load; do
 done
 echo "$(ts) measurement batch starts"
 
-echo "$(ts) [1/5] bench.py headline"
+echo "$(ts) [1/6] bench.py headline"
 # the runner's own patient probe just succeeded; skip bench.py's
 # subprocess probe (its timeout SIGKILL is itself a wedge risk)
-MARLIN_BENCH_SKIP_PROBE=1 python bench.py >BENCH_PROBE_r4.json
-echo "$(ts) headline: $(cat BENCH_PROBE_r4.json)"
+MARLIN_BENCH_SKIP_PROBE=1 python bench.py >BENCH_PROBE_r5.json
+echo "$(ts) headline: $(cat BENCH_PROBE_r5.json)"
 
-echo "$(ts) [1b/5] pallas kernel smoke (first Mosaic compile of the bwd)"
+echo "$(ts) [1b/6] pallas kernel smoke (first Mosaic compile of the bwd)"
 if python tools/tpu_smoke.py; then
   SMOKE_OK=1
 else
@@ -77,27 +81,35 @@ else
   echo "$(ts) SMOKE FAILED — skipping flash-dependent long-context configs"
 fi
 
-echo "$(ts) [2/5] bench_all: previously-run shapes (fresh numbers) + decode"
-python bench_all.py 3 bf16 lu chol lct nn decode
+echo "$(ts) [2/6] bench_all: previously-run shapes (fresh numbers) + decode"
+# decode's prompt sweep crosses the flash-prefill threshold — flash-gated
+if [ "$SMOKE_OK" = 1 ]; then
+  python bench_all.py 3 bf16 lu chol lct nn decode
+else
+  MARLIN_BENCH_DECODE_SWEEP=0 python bench_all.py 3 bf16 lu chol lct nn decode
+fi
 
-echo "$(ts) [3/5] bench_all: new configs (riskier, after the safe ones)"
+echo "$(ts) [3/6] bench_all: new configs (riskier, after the safe ones)"
 if [ "$SMOKE_OK" = 1 ]; then
   python bench_all.py lct_long attn_long bsr 4
 else
   python bench_all.py bsr 4
 fi
 
+echo "$(ts) [3b/6] HBM high-water on-chip vs AOT prediction (verdict r4 #2)"
+python tools/hbm_probe.py || echo "$(ts) hbm_probe failed (non-fatal)"
+
 if [ "$SMOKE_OK" = 1 ]; then
-  echo "$(ts) [4/5] long-context escalation: 512k"
+  echo "$(ts) [4/6] long-context escalation: 512k"
   MARLIN_BENCH_LCT_SEQ=524288 MARLIN_BENCH_ATTN_SEQ=524288 \
     python bench_all.py lct_long attn_long
 
-  echo "$(ts) [5/5] long-context escalation: 1M (bf16 — f32 exceeds HBM at 1M"
+  echo "$(ts) [5/6] long-context escalation: 1M (bf16 — f32 exceeds HBM at 1M"
   echo "            per AOT_MEMORY.json; attn fwd fits at f32 either way)"
   MARLIN_BENCH_LCT_SEQ=1048576 MARLIN_BENCH_ATTN_SEQ=1048576 \
     MARLIN_BENCH_LCT_DTYPE=bfloat16 python bench_all.py lct_long attn_long
 else
-  echo "$(ts) [4-5/5] skipped (smoke failed)"
+  echo "$(ts) [4-5/6] skipped (smoke failed)"
 fi
 
 echo "$(ts) [6] refresh of remaining round-2 configs (lowest priority)"

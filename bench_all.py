@@ -357,7 +357,7 @@ def config_nn(m=262_144, d=784, hidden=1024, classes=10, batch=8192,
 
 def config_lct(seq=32768, d_model=256, heads=2, layers=2, steps=3,
                remat=False, loss_chunk=None, name=None, attn="ring",
-               compute_dtype=None, mlp_chunk=None):
+               compute_dtype=None, mlp_chunk=None, offload_residuals=False):
     """Long-context LM training throughput: one 32k-token causal stream,
     flash ring attention (dh=128 -> MXU tiles), Adam, full backward through
     the sequence-parallel attention (recompute VJP). No reference analog —
@@ -374,7 +374,8 @@ def config_lct(seq=32768, d_model=256, heads=2, layers=2, steps=3,
     lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
                        layers=layers, attn=attn, remat=remat,
                        loss_chunk=loss_chunk, compute_dtype=compute_dtype,
-                       mlp_chunk=mlp_chunk)
+                       mlp_chunk=mlp_chunk,
+                       offload_residuals=offload_residuals)
     params, _ = lm.train(tokens, steps=1, mesh=mesh)  # compile
     t0 = time.perf_counter()
     params, losses = lm.train(tokens, steps=steps, mesh=mesh, params=params)
@@ -414,10 +415,26 @@ def config_lct_long():
     # REQUIRED at 1M tokens (f32 needs 22 GiB; bf16 fits — AOT_MEMORY.json)
     cd = os.environ.get("MARLIN_BENCH_LCT_DTYPE") or None
     mc = int(os.environ.get("MARLIN_BENCH_LCT_MLP_CHUNK", 0)) or None
+    remat, lc, off = True, 16384, False
+    if os.environ.get("MARLIN_BENCH_LCT_PLAN") == "1":
+        # let the planner pick the knobs from the compiler's own memory
+        # accounting (models/planner.py) instead of the hand-set defaults —
+        # costs one AOT compile per probed rung (~1 min each at 1M tokens),
+        # which is why it is opt-in for the relay-uptime-limited batch
+        from marlin_tpu.models import TransformerLM, plan_context
+
+        base = TransformerLM(vocab=512, d_model=256, heads=2, layers=2,
+                             attn="ring_flash")
+        plan = plan_context(seq, base)
+        print(f"[lct_long] planner: {plan.describe()}", flush=True)
+        m = plan.model
+        remat, lc, mc, cd, off = m.remat, m.loss_chunk, m.mlp_chunk, \
+            m.compute_dtype, m.offload_residuals
     suffix = f"_{cd}" if cd else ""
-    config_lct(seq=seq, steps=2, remat=True, loss_chunk=16384,
+    config_lct(seq=seq, steps=2, remat=remat, loss_chunk=lc,
                name=f"lct_long_{seq}tok_d256_h2_l2{suffix}",
-               attn="ring_flash", compute_dtype=cd, mlp_chunk=mc)
+               attn="ring_flash", compute_dtype=cd, mlp_chunk=mc,
+               offload_residuals=off)
 
 
 def config_decode(d_model=512, heads=8, layers=4, vocab=4096,

@@ -225,8 +225,19 @@ def save_checkpoint(state, path: str, step: int) -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(f"marlin_ckpt_{step}")
-    with open_path(join_path(path, "latest"), "w") as f:
-        f.write(str(step))
+    # single-writer 'latest' (ADVICE r4): identical concurrent writes are
+    # benign on POSIX but undefined through remote-FS hooks (object stores
+    # can fail or tear concurrent same-object puts) — proc 0 alone flips the
+    # pointer, after the shard barrier above guaranteed durability. The
+    # trailing barrier keeps save_checkpoint's postcondition ("latest points
+    # at this step on return") true on EVERY process, not just proc 0.
+    if jax.process_index() == 0:
+        with open_path(join_path(path, "latest"), "w") as f:
+            f.write(str(step))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"marlin_ckpt_latest_{step}")
 
 
 def load_checkpoint(state_like, path: str, step: int | None = None):

@@ -1,0 +1,194 @@
+"""Automatic long-context memory planning via the AOT compile-only channel.
+
+The long-context HBM knobs — ``remat``, ``loss_chunk``, ``mlp_chunk``,
+``compute_dtype`` — each trade throughput (or precision) for activation
+memory, and their interactions are tabulated in docs/parallelism.md. Picking
+them by hand means reading that table; :func:`plan_context` picks them by
+asking the TPU compiler directly: it AOT-compiles the REAL training step
+(``lm_train_step``) against a compile-only v5e topology (utils/aot.py — no
+chip, no relay) and escalates knobs, cheapest-throughput-cost first, until
+the compiler's own peak-HBM accounting fits the budget.
+
+The budget defaults to *usable* HBM: the measured ``bytes_limit`` from
+HBM_ONCHIP.json when the on-chip probe has run, else raw capacity minus a
+documented reserve (see :func:`usable_hbm_bytes`) — a "fits" from this
+planner is keyed to what the runtime actually grants, not the sticker 16 GiB
+(round-4 verdict #2).
+
+No reference analog: the reference's memory knobs are static conf keys
+(``marlin.*.basesize``, SURVEY.md §5.6) that the user tunes by trial OOM;
+this is only possible because XLA compiles the whole step ahead of time and
+reports its memory plan.
+
+Each probe compile costs roughly a minute at 1M tokens (AOT_MEMORY.json
+``compile_s``), so the ladder stops at the FIRST fitting rung; planning a
+flagship config costs a few minutes once, offline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+
+__all__ = ["plan_context", "ContextPlan", "usable_hbm_bytes"]
+
+GIB = 1024 ** 3
+
+# Headroom policy (docs/parallelism.md): when no measured usable-HBM figure
+# exists, reserve this much of raw capacity for the runtime/framework — the
+# v5e reserves a slice of its 16 GiB that compile-time accounting never sees.
+DEFAULT_RESERVE_BYTES = 3 * GIB // 4  # 0.75 GiB
+
+_HBM_ONCHIP = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "HBM_ONCHIP.json")
+
+
+def usable_hbm_bytes(total_bytes: int = 16 * GIB,
+                     onchip_report: str | None = None) -> int:
+    """The planning budget: the device's measured ``bytes_limit`` (what the
+    TPU runtime actually grants, recorded in HBM_ONCHIP.json by
+    tools/hbm_probe.py) when available, else ``total_bytes`` minus the
+    documented reserve."""
+    path = onchip_report or _HBM_ONCHIP
+    try:
+        with open(path) as f:
+            limit = int(json.load(f).get("bytes_limit", 0))
+        if limit > 0:
+            return limit
+    except (FileNotFoundError, ValueError):
+        pass
+    return total_bytes - DEFAULT_RESERVE_BYTES
+
+
+@dataclasses.dataclass(frozen=True)
+class ContextPlan:
+    """The planner's verdict: ``model`` is the escalated TransformerLM ready
+    to train; ``trail`` records every rung probed as
+    ``(knobs, peak_bytes | None, fits, note)``."""
+
+    model: object  # TransformerLM
+    knobs: dict
+    peak_bytes: int | None
+    fits: bool
+    budget_bytes: int
+    seq: int
+    trail: tuple
+
+    @property
+    def peak_gib(self) -> float | None:
+        return None if self.peak_bytes is None else round(
+            self.peak_bytes / GIB, 3)
+
+    def describe(self) -> str:
+        head = (f"seq={self.seq}: {'fits' if self.fits else 'DOES NOT FIT'} "
+                f"{self.peak_gib} GiB of {round(self.budget_bytes / GIB, 3)} "
+                f"GiB usable with {self.knobs or 'no knobs'}")
+        rungs = "\n".join(
+            f"  probed {k or '{}'}: "
+            f"{'?' if p is None else round(p / GIB, 3)} GiB"
+            f"{' (fits)' if f else ''}{' — ' + n if n else ''}"
+            for k, p, f, n in self.trail)
+        return head + "\n" + rungs
+
+
+def _compiled_peak(model, seq: int, mesh) -> tuple[int | None, str]:
+    """(peak_bytes, note) for one lm_train_step compile on the AOT topology.
+    An over-HBM rejection is a result: the compiler names its own usage,
+    which becomes the rung's peak (same contract as tools/aot_report._try)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..config import config_context
+    from .transformer import lm_train_step
+
+    rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+    def sds(tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
+                                           sharding=rep), tree)
+
+    params = jax.eval_shape(model.init_params)
+    opt_state = jax.eval_shape(optax.adam(model.learning_rate).init, params)
+    tokens = jax.ShapeDtypeStruct((seq,), jnp.int32, sharding=rep)
+    try:
+        with config_context(pallas_interpret=False):
+            compiled = lm_train_step.trace(
+                sds(params), sds(opt_state), tokens, mesh, model.heads,
+                model.attn, model.remat, model.precision,
+                model.learning_rate, model.loss_chunk, model.compute_dtype,
+                model.mlp_chunk, model.offload_residuals,
+            ).lower().compile()
+        return compiled.memory_analysis().peak_memory_in_bytes, ""
+    except Exception as e:
+        m = re.search(r"Used ([0-9.]+)([GMK]) of [0-9.]+[GMK] hbm", str(e))
+        if m:
+            mult = {"K": 1024, "M": 1024 ** 2, "G": GIB}[m.group(2)]
+            return int(float(m.group(1)) * mult), "compiler rejected (>HBM)"
+        return None, "compile failed: " + str(e).split("\n")[0][:160]
+
+
+def _ladder(model, seq: int):
+    """Cumulative knob escalation, cheapest throughput cost first (the
+    docs/parallelism.md ordering): remat trades FLOPs, the chunk knobs trade
+    scan overhead, bf16 trades activation precision, and host-offloaded
+    residuals trade PCIe traffic (last — it only nets out for
+    residual-dominated shapes). Rungs already set on the user's config are
+    skipped (they cannot un-set)."""
+    rungs = [{}]
+    acc = {}
+    chunk = max(1, min(16384, seq))
+    for knob, val in (("remat", True), ("loss_chunk", chunk),
+                      ("mlp_chunk", chunk), ("compute_dtype", "bfloat16"),
+                      ("offload_residuals", True)):
+        if getattr(model, knob, None) in (None, False):
+            acc = dict(acc, **{knob: val})
+            rungs.append(dict(acc))
+    return rungs
+
+
+def plan_context(seq: int, model, hbm_budget: int | None = None,
+                 topology_name: str = "v5e:2x2", measure=None):
+    """Pick the cheapest knob set under which ``model`` trains ``seq`` tokens
+    within ``hbm_budget`` bytes on one chip, by compiler accounting.
+
+    ``model`` is a :class:`~marlin_tpu.models.transformer.TransformerLM`
+    (its existing knob settings are respected and never weakened).
+    ``hbm_budget`` defaults to :func:`usable_hbm_bytes`. ``measure`` overrides
+    the probe (tests); the default compiles on the compile-only topology and
+    needs libtpu (:func:`marlin_tpu.utils.aot.supports_aot_tpu`).
+
+    Returns a :class:`ContextPlan`; when nothing fits, the plan carries the
+    lowest-peak rung with ``fits=False`` — its ``peak_bytes / budget`` ratio
+    is the chip count the mesh needs (sequence memory shards ~linearly over
+    the ring; AOT_MEMORY.json ``lct_long_4chip``), or see the host-offload
+    path in docs/parallelism.md."""
+    budget = usable_hbm_bytes() if hbm_budget is None else int(hbm_budget)
+    if measure is None:
+        from ..utils.aot import topology_mesh
+
+        mesh = topology_mesh(("rows",), (1,), topology_name=topology_name)
+
+        def measure(m):
+            return _compiled_peak(m, seq, mesh)
+
+    trail = []
+    best = None  # (peak, knobs, model)
+    for knobs in _ladder(model, seq):
+        candidate = dataclasses.replace(model, **knobs)
+        peak, note = measure(candidate)
+        fits = peak is not None and peak <= budget
+        trail.append((knobs, peak, fits, note))
+        if peak is not None and (best is None or peak < best[0]):
+            best = (peak, knobs, candidate)
+        if fits:
+            return ContextPlan(model=candidate, knobs=knobs, peak_bytes=peak,
+                               fits=True, budget_bytes=budget, seq=seq,
+                               trail=tuple(trail))
+    peak, knobs, candidate = best if best else (None, {}, model)
+    return ContextPlan(model=candidate, knobs=knobs, peak_bytes=peak,
+                       fits=False, budget_bytes=budget, seq=seq,
+                       trail=tuple(trail))

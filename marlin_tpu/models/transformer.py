@@ -135,7 +135,8 @@ def transformer_forward(params: dict, tokens, mesh=None, heads: int = 4,
                         attn: str = "ring", remat: bool = False,
                         precision: str = "high",
                         compute_dtype: str | None = None,
-                        mlp_chunk: int | None = None):
+                        mlp_chunk: int | None = None,
+                        offload_residuals: bool = False):
     """Logits for next-token prediction; ``tokens`` is a (seq,) int array.
     ``attn``: "ring" (sequence rotates K/V panels; backend auto-picked),
     "ring_flash" / "ring_xla" (ring with the backend pinned), or "ulysses"
@@ -144,9 +145,10 @@ def transformer_forward(params: dict, tokens, mesh=None, heads: int = 4,
     sequences. ``compute_dtype`` (e.g. "bfloat16") runs the *activations*
     through that dtype while params/optimizer stay f32 — the other half of
     the long-context HBM budget (activations dominate it; see
-    docs/parallelism.md) and the bf16-MXU speed path."""
+    docs/parallelism.md) and the bf16-MXU speed path. ``offload_residuals``
+    parks the remat checkpoints in host RAM (:func:`_trunk`)."""
     x = _trunk(params, tokens, mesh, heads, attn, remat, precision,
-               compute_dtype, mlp_chunk)
+               compute_dtype, mlp_chunk, offload_residuals)
     return _head_logits(x, params["emb"])
 
 
@@ -159,17 +161,26 @@ def _head_logits(x, emb):
 
 
 def _trunk(params, tokens, mesh, heads, attn, remat, precision,
-           compute_dtype=None, mlp_chunk=None):
+           compute_dtype=None, mlp_chunk=None, offload_residuals=False):
     """Final-rmsnorm hidden states, (seq, d_model) — the forward minus the
     LM head projection. With ``compute_dtype``, the residual stream and every
     matmul operand are cast to it (norm statistics and softmax stay f32
     inside their ops; the flash kernels accumulate in f32 via
-    preferred_element_type)."""
+    preferred_element_type). With ``offload_residuals`` (requires ``remat``),
+    the per-layer residual checkpoints — the block inputs, the only forward
+    state remat keeps — are moved to pinned host RAM between the forward and
+    the backward (``save_and_offload_only_these_names``), removing the
+    L·S·d term from device HBM entirely: the knob that carries training past
+    the single-chip context cliff (docs/parallelism.md; SURVEY §7
+    "matrices bigger than HBM")."""
     from ..mesh import default_mesh
 
     mesh = mesh or default_mesh()
     if attn not in (*_ATTN_BACKENDS, "ulysses"):
         raise ValueError(f"unknown attention strategy: {attn!r}")
+    if offload_residuals and not remat:
+        raise ValueError("offload_residuals requires remat=True (without "
+                         "remat there are no residual checkpoints to offload)")
     # NOTE: cast AFTER the gather. Casting the (vocab, d) table first reads
     # nicely but measures worse (+1 GiB at 2M tokens in the compiler's
     # accounting: the gather's backward becomes a bf16 scatter + upcast)
@@ -177,12 +188,37 @@ def _trunk(params, tokens, mesh, heads, attn, remat, precision,
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
     n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
-    for i in range(n_layers):
-        blk = functools.partial(_block, heads=heads, mesh=mesh, attn=attn,
-                                precision=precision, mlp_chunk=mlp_chunk)
-        blk = jax.checkpoint(blk) if remat else blk
-        x = blk(params[f"l{i}"], x)
+    blk = functools.partial(_block, heads=heads, mesh=mesh, attn=attn,
+                            precision=precision, mlp_chunk=mlp_chunk)
+    if remat and offload_residuals:
+        # scan over STACKED layers: in a Python loop the inter-block
+        # residuals are plain SSA values XLA keeps on device regardless of
+        # any offload annotation (measured: device peak ROSE ~2x), but as a
+        # scan carry they are policy-controlled residuals — named via
+        # checkpoint_name, saved to pinned_host, fetched back per backward
+        # iteration
+        from jax.ad_checkpoint import checkpoint_name
+
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
+                               *[params[f"l{i}"] for i in range(n_layers)])
+
+        def body(h, lp):
+            return blk(lp, checkpoint_name(h, "marlin_resid")), None
+
+        body = jax.checkpoint(body, policy=_OFFLOAD_POLICY())
+        x, _ = jax.lax.scan(body, x, stacked)
+    else:
+        for i in range(n_layers):
+            b = jax.checkpoint(blk) if remat else blk
+            x = b(params[f"l{i}"], x)
     return _rmsnorm(x, params["ln_f"])
+
+
+def _OFFLOAD_POLICY():
+    return jax.checkpoint_policies.save_and_offload_only_these_names(
+        names_which_can_be_saved=[],
+        names_which_can_be_offloaded=["marlin_resid"],
+        offload_src="device", offload_dst="pinned_host")
 
 
 def _chunked_nll(x, emb, targets, chunk: int):
@@ -214,33 +250,36 @@ def _chunked_nll(x, emb, targets, chunk: int):
 def lm_loss(params, tokens, mesh=None, heads: int = 4, attn: str = "ring",
             remat: bool = False, precision: str = "high",
             loss_chunk: int | None = None, compute_dtype: str | None = None,
-            mlp_chunk: int | None = None):
+            mlp_chunk: int | None = None, offload_residuals: bool = False):
     """Mean next-token cross-entropy over the sequence. ``loss_chunk`` scans
     the LM head over that many tokens at a time (see :func:`_chunked_nll`) —
     the long-context memory knob companion to ``remat``. ``compute_dtype``
-    runs activations in that dtype (loss math itself stays f32)."""
+    runs activations in that dtype (loss math itself stays f32);
+    ``offload_residuals`` parks the remat checkpoints in host RAM
+    (see :func:`_trunk`)."""
     tgt = jnp.asarray(tokens[1:])
     if loss_chunk is None:
         logits = transformer_forward(params, tokens[:-1], mesh, heads, attn,
                                      remat, precision, compute_dtype,
-                                     mlp_chunk)
+                                     mlp_chunk, offload_residuals)
         logp = jax.nn.log_softmax(logits, axis=-1)
         return -jnp.mean(jnp.take_along_axis(logp, tgt[:, None], axis=1))
     if loss_chunk < 1:
         raise ValueError(f"loss_chunk must be >= 1 or None, got {loss_chunk}")
     x = _trunk(params, tokens[:-1], mesh, heads, attn, remat, precision,
-               compute_dtype, mlp_chunk)
+               compute_dtype, mlp_chunk, offload_residuals)
     return _chunked_nll(x, params["emb"], tgt, loss_chunk) / tgt.shape[0]
 
 
 @functools.partial(jax.jit, static_argnames=(
     "mesh", "heads", "attn", "remat", "precision", "lr", "loss_chunk",
-    "compute_dtype", "mlp_chunk"))
+    "compute_dtype", "mlp_chunk", "offload_residuals"))
 def lm_train_step(params, opt_state, tokens, mesh, heads: int, attn: str,
                   remat: bool, precision: str, lr: float,
                   loss_chunk: int | None = None,
                   compute_dtype: str | None = None,
-                  mlp_chunk: int | None = None):
+                  mlp_chunk: int | None = None,
+                  offload_residuals: bool = False):
     """One Adam step, jitted at module level with static config primitives so
     repeated ``train()`` calls (and the bench's warm-up-then-time discipline)
     hit one compiled program — the same cache pattern as
@@ -249,7 +288,8 @@ def lm_train_step(params, opt_state, tokens, mesh, heads: int, attn: str,
 
     loss, grads = jax.value_and_grad(
         lambda p: lm_loss(p, tokens, mesh, heads, attn, remat, precision,
-                          loss_chunk, compute_dtype, mlp_chunk)
+                          loss_chunk, compute_dtype, mlp_chunk,
+                          offload_residuals)
     )(params)
     updates, opt_state = optax.adam(lr).update(grads, opt_state, params)
     return optax.apply_updates(params, updates), opt_state, loss
@@ -322,7 +362,9 @@ def _prefill_attn(q, k, v, cdtype):
     from ..mesh import pad_to_multiple
     from ..ops.flash_attention import flash_attention_single_panel
 
-    pp = pad_to_multiple(P, 128)  # Mosaic f32 tile; valid_len masks the pad
+    # the flash block contract (ops/flash_attention.block_divisor): > 1024
+    # pads to 1024 multiples, shorter to 128; valid_len masks the pad
+    pp = pad_to_multiple(P, 1024 if P > 1024 else 128)
     pad = [(0, pp - P), (0, 0)]
 
     def one_head(qh, kh, vh):
@@ -443,6 +485,14 @@ class TransformerLM:
     # GELU intermediate at (chunk, d_ff) — worth ~GiBs at 1M+ tokens, more
     # at larger d_ff
     mlp_chunk: int | None = None
+    # park the remat residual checkpoints (L·S·d, the only forward state
+    # remat keeps) in pinned host RAM between forward and backward. The knob
+    # for residual-DOMINATED shapes (many layers x large d_model): the
+    # compiler confirms the checkpoints move to host temps, but the
+    # scan-over-layers formulation it requires costs some device memory
+    # back, so at small L·d it is net-neutral (AOT_MEMORY.json
+    # lct_long_bf16_offload). Requires remat=True.
+    offload_residuals: bool = False
 
     def init_params(self, dtype=jnp.float32) -> dict:
         return init_transformer(jax.random.key(self.seed), self.vocab,
@@ -470,6 +520,7 @@ class TransformerLM:
                 params, opt_state, tokens, mesh, self.heads, self.attn,
                 self.remat, self.precision, self.learning_rate,
                 self.loss_chunk, self.compute_dtype, self.mlp_chunk,
+                self.offload_residuals,
             )
             losses.append(float(loss))
             if log_every and (it + 1) % log_every == 0:

@@ -37,19 +37,29 @@ _NEG = -1e30
 
 
 def block_divisor(n: int, cap: int | None = None) -> int:
-    """Largest power-of-two ≤ cap dividing n — the flash block-size policy
-    shared by every caller of :func:`flash_attention_panel` (ring + ulysses).
-    Callers pad panels to 128 multiples so this never degenerates below the
-    (8, 128) f32 tile Mosaic wants.
+    """The flash block-size policy shared by every caller of
+    :func:`flash_attention_panel` (ring + ulysses + prefill).
 
-    The default cap is panel-adaptive: 1024 up to 32k panels, 512 beyond.
-    Mosaic's scoped-VMEM budget (16 MB default) fits the 1024-block window
-    set only while the full-length (n, 1) m/l state stays small; at ≥64k
-    panels the 1024-block kernel exceeds it by ~3 MB and fails to compile
-    (caught by the AOT compile-only channel, tests/test_aot_tpu.py), while
-    512 blocks compile clean through 1M-token panels."""
+    m/l (and the backward's lse/Δ) cross the kernel boundary in the
+    exact-packed ``(n//128, 128)`` form (see ``_panel_kernel`` — the
+    ``(n, 1)`` form tile-pads 128x in HBM), and Pallas requires their
+    ``(bq//128, 128)`` blocks to have sublanes divisible by 8 or equal to
+    the whole array. Hence the contract: panels longer than 1024 are padded
+    by the callers to 1024 multiples and run ``bq=1024`` (blocks (8, 128) —
+    legal, and with the packed m/l the old 1024-block scoped-VMEM overflow
+    at ≥64k panels is gone: the overage WAS the six (1024, 1)→(1024, 128)
+    padded m/l blocks); shorter panels run as one whole-panel block
+    (``bq == n``, the "equal to the array" clause). 1024 is also the VMEM
+    ceiling for the (bq, bkv) f32 score tile itself — 4 MB; a 2048
+    whole-panel tile would be 16 MB, the entire scoped budget. With an
+    explicit ``cap`` (tests), the largest power-of-two divisor ≤ cap is
+    returned unchanged."""
     if cap is None:
-        cap = 1024 if n <= 32768 else 512
+        if n % 1024 == 0:
+            return 1024
+        if n % 128 == 0 and n <= 1024:
+            return n  # single whole-panel block
+        cap = 1024  # unpadded legacy caller: interpret-mode only
     b = 1
     while b < cap and n % (b * 2) == 0:
         b *= 2
@@ -59,6 +69,16 @@ def block_divisor(n: int, cap: int | None = None) -> int:
 def _panel_kernel(s_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
                   m_out, l_out, acc_out, m_s, l_s, acc_s,
                   *, causal: bool, scale: float, bq: int, bkv: int):
+    # m/l cross the kernel boundary as (bq//128, 128) blocks — the
+    # exact-packed form of the per-row vectors: value for q-row p lives at
+    # (p // 128, p % 128), which under the TPU's (8, 128) tiling is the SAME
+    # byte layout as the 1-D (bq,) row vector, so every reshape between
+    # (bq, X) and (bq//128, 128, X) below is layout-free. The (bq, 1) form
+    # this replaces tile-pads 128x — ~0.5 GiB of dead HBM per m/l tensor per
+    # head at 1M-token panels, the dominant non-data term in the measured
+    # flash footprint — and plain 1-D (bq,) blocks Mosaic rejects whenever
+    # bq differs from XLA's 1024-element 1-D tile.
+    g = bq // 128
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -87,17 +107,21 @@ def _panel_kernel(s_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
         if causal:
             qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
             keep = jnp.logical_and(keep, qpos >= kpos)
-        s = jnp.where(keep, s, _NEG)
+        s3 = jnp.where(keep, s, _NEG).reshape(g, 128, bkv)
         m_prev = m_s[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        m_new = jnp.maximum(m_prev, jnp.max(s3, axis=2))
         alpha = jnp.exp(m_prev - m_new)
         # exp(s - m_new) alone mis-handles a fully-masked row whose running
         # max is still _NEG (exp(0) = 1 per masked key); zero them exactly
-        p = jnp.where(keep, jnp.exp(s - m_new), 0.0)
-        l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_s[:] = acc_s[:] * alpha + jnp.dot(
-            p.astype(v_ref.dtype), v_ref[:], preferred_element_type=jnp.float32
-        )
+        p3 = jnp.where(keep.reshape(g, 128, bkv),
+                       jnp.exp(s3 - m_new[:, :, None]), 0.0)
+        l_s[:] = l_s[:] * alpha + jnp.sum(p3, axis=2)
+        pv = jnp.dot(p3.reshape(bq, bkv).astype(v_ref.dtype), v_ref[:],
+                     preferred_element_type=jnp.float32)
+        d = acc_s.shape[-1]
+        acc3 = acc_s[:].reshape(g, 128, d)
+        acc_s[:] = (acc3 * alpha[:, :, None]
+                    + pv.reshape(g, 128, d)).reshape(bq, d)
         m_s[:] = m_new
 
     @pl.when(j == pl.num_programs(1) - 1)
@@ -119,7 +143,9 @@ def _bwd_p_ds(q_blk, k_blk, v_blk, do_blk, lse_blk, delta_blk,
               bq: int, bkv: int):
     """Recompute the (bq, bkv) probability tile from the forward's logsumexp
     and form ds = p ⊙ (dOᐧVᵀ − Δ) — the shared core of both backward kernels.
-    Saved state is O(seq): lse and Δ rows, never score tiles."""
+    Saved state is O(seq): lse and Δ rows in the exact-packed (bq//128, 128)
+    block form (see _panel_kernel on why), never score tiles."""
+    g = bq // 128
     s = jax.lax.dot_general(
         q_blk, k_blk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -129,12 +155,15 @@ def _bwd_p_ds(q_blk, k_blk, v_blk, do_blk, lse_blk, delta_blk,
     if causal:
         qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
         keep = jnp.logical_and(keep, qpos >= kpos)
-    p = jnp.where(keep, jnp.exp(s - lse_blk), 0.0)
+    s3 = s.reshape(g, 128, bkv)
+    p = jnp.where(keep, jnp.exp(s3 - lse_blk[:, :, None]).reshape(bq, bkv),
+                  0.0)
     dp = jax.lax.dot_general(
         do_blk, v_blk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    ds = p * (dp - delta_blk)
+    ds = p * (dp.reshape(g, 128, bkv)
+              - delta_blk[:, :, None]).reshape(bq, bkv)
     return p, ds
 
 
@@ -209,25 +238,45 @@ def flash_attention_panel_bwd(q, k, v, do, lse, delta, q_offset, k_offset,
                               interpret: bool | None = None):
     """Backward of one flash panel — the classic two-pass recompute schedule:
     probabilities are rebuilt per tile from the forward's ``lse`` rows
-    (lse = m + log l) and ``delta`` (= rowsum(dO ⊙ O)), so the backward holds
-    O(block²) score memory instead of the O(seq · tile) residuals an autodiff
-    of the tiled formulation would save. Returns f32 ``(dq, dk, dv)`` for this
-    panel; the ring caller sums dq over panels and rotates dk/dv home.
+    (lse = m + log l) and ``delta`` (= rowsum(dO ⊙ O)), both 1-D ``(sq,)``
+    (lane-major — see _panel_kernel on the (n, 1) HBM padding), so the
+    backward holds O(block²) score memory instead of the O(seq · tile)
+    residuals an autodiff of the tiled formulation would save. Returns f32
+    ``(dq, dk, dv)`` for this panel; the ring caller sums dq over panels and
+    rotates dk/dv home.
     """
     sq, d = q.shape
     skv = k.shape[0]
     bq = min(bq, sq)
     bkv = min(bkv, skv)
+    # the backward holds three (bq, bkv) f32 tiles at once (p, ds, dOᐧVᵀ) —
+    # at 1024x1024 that is 12 MB of tiles and the kernel total overflows the
+    # 16 MB scoped-VMEM budget by ~0.8 MB (the forward's two tiles fit), so
+    # the K/V tile halves at the 1024 block size
+    if bq >= 1024 and bkv >= 1024 and skv % (bkv // 2) == 0:
+        bkv //= 2
     if sq % bq or skv % bkv:
         raise ValueError(f"block sizes ({bq},{bkv}) must divide panel dims "
                          f"({sq},{skv})")
+    if sq % 128 or bq % 128:
+        raise ValueError(f"panel length ({sq}) and bq ({bq}) must be "
+                         "multiples of 128 (lse/Δ rows are carried in the "
+                         "exact-packed (n//128, 128) form)")
     if interpret is None:
         interpret = _interpret()
+    if not interpret and (bq // 128) % 8 and bq != sq:
+        raise ValueError(
+            f"bq ({bq}) must be a multiple of 1024 or the whole panel "
+            f"({sq}) for the TPU lowering — pad panels > 1024 to 1024 "
+            "multiples (block_divisor documents the contract)")
     scalars = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                          jnp.asarray(k_offset, jnp.int32),
                          jnp.asarray(valid_len, jnp.int32)])
     vma = getattr(jax.typeof(q), "vma", frozenset())
     f32 = jnp.float32
+    g = bq // 128
+    lse2 = lse.reshape(sq // 128, 128)
+    delta2 = delta.reshape(sq // 128, 128)
 
     kern_kv = functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
                                 bq=bq, bkv=bkv)
@@ -239,8 +288,8 @@ def flash_attention_panel_bwd(q, k, v, do, lse, delta, q_offset, k_offset,
             in_specs=[
                 pl.BlockSpec((bq, d), lambda j, i, *_: (i, 0)),
                 pl.BlockSpec((bq, d), lambda j, i, *_: (i, 0)),
-                pl.BlockSpec((bq, 1), lambda j, i, *_: (i, 0)),
-                pl.BlockSpec((bq, 1), lambda j, i, *_: (i, 0)),
+                pl.BlockSpec((g, 128), lambda j, i, *_: (i, 0)),
+                pl.BlockSpec((g, 128), lambda j, i, *_: (i, 0)),
                 pl.BlockSpec((bkv, d), lambda j, i, *_: (j, 0)),
                 pl.BlockSpec((bkv, d), lambda j, i, *_: (j, 0)),
             ],
@@ -258,7 +307,7 @@ def flash_attention_panel_bwd(q, k, v, do, lse, delta, q_offset, k_offset,
             jax.ShapeDtypeStruct((skv, d), f32, vma=vma),
         ],
         interpret=interpret,
-    )(scalars, q, do, lse, delta, k, v)
+    )(scalars, q, do, lse2, delta2, k, v)
 
     kern_q = functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
                                bq=bq, bkv=bkv)
@@ -270,8 +319,8 @@ def flash_attention_panel_bwd(q, k, v, do, lse, delta, q_offset, k_offset,
             in_specs=[
                 pl.BlockSpec((bq, d), lambda i, j, *_: (i, 0)),
                 pl.BlockSpec((bq, d), lambda i, j, *_: (i, 0)),
-                pl.BlockSpec((bq, 1), lambda i, j, *_: (i, 0)),
-                pl.BlockSpec((bq, 1), lambda i, j, *_: (i, 0)),
+                pl.BlockSpec((g, 128), lambda i, j, *_: (i, 0)),
+                pl.BlockSpec((g, 128), lambda i, j, *_: (i, 0)),
                 pl.BlockSpec((bkv, d), lambda i, j, *_: (j, 0)),
                 pl.BlockSpec((bkv, d), lambda i, j, *_: (j, 0)),
             ],
@@ -280,7 +329,7 @@ def flash_attention_panel_bwd(q, k, v, do, lse, delta, q_offset, k_offset,
         ),
         out_shape=jax.ShapeDtypeStruct((sq, d), f32, vma=vma),
         interpret=interpret,
-    )(scalars, q, do, lse, delta, k, v)
+    )(scalars, q, do, lse2, delta2, k, v)
     return dq, dk, dv
 
 
@@ -290,7 +339,8 @@ def flash_attention_panel(q, k, v, m, l, acc, q_offset, k_offset, valid_len,
     """One flash pass of queries ``q`` (sq, d) against a K/V panel (skv, d),
     updating the running state:
 
-    - ``m``/``l``: (sq, 1) f32 running max / softmax denominator
+    - ``m``/``l``: (sq,) f32 running max / softmax denominator — 1-D because
+      (sq, 1) tile-pads 128x in HBM (see _panel_kernel)
     - ``acc``: (sq, d) f32 unnormalized output accumulator
     - ``q_offset``/``k_offset``: global positions of q row 0 / panel key 0
       (the ring caller's device coordinate × block size)
@@ -307,11 +357,24 @@ def flash_attention_panel(q, k, v, m, l, acc, q_offset, k_offset, valid_len,
     if sq % bq or skv % bkv:
         raise ValueError(f"block sizes ({bq},{bkv}) must divide panel dims "
                          f"({sq},{skv})")
+    if sq % 128 or bq % 128:
+        raise ValueError(f"panel length ({sq}) and bq ({bq}) must be "
+                         "multiples of 128 (the m/l rows are carried in the "
+                         "exact-packed (n//128, 128) form)")
     if interpret is None:
         interpret = _interpret()
+    if not interpret and (bq // 128) % 8 and bq != sq:
+        # the packed m/l BlockSpec needs 8-divisible sublanes or the whole
+        # array (Pallas TPU constraint) — fail here with the contract named
+        # instead of deep inside Mosaic; interpret mode has no such limit
+        raise ValueError(
+            f"bq ({bq}) must be a multiple of 1024 or the whole panel "
+            f"({sq}) for the TPU lowering — pad panels > 1024 to 1024 "
+            "multiples (block_divisor documents the contract)")
     scalars = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                          jnp.asarray(k_offset, jnp.int32),
                          jnp.asarray(valid_len, jnp.int32)])
+    g = bq // 128
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(sq // bq, skv // bkv),
@@ -319,18 +382,18 @@ def flash_attention_panel(q, k, v, m, l, acc, q_offset, k_offset, valid_len,
             pl.BlockSpec((bq, d), lambda i, j, *_: (i, 0)),
             pl.BlockSpec((bkv, d), lambda i, j, *_: (j, 0)),
             pl.BlockSpec((bkv, d), lambda i, j, *_: (j, 0)),
-            pl.BlockSpec((bq, 1), lambda i, j, *_: (i, 0)),
-            pl.BlockSpec((bq, 1), lambda i, j, *_: (i, 0)),
+            pl.BlockSpec((g, 128), lambda i, j, *_: (i, 0)),
+            pl.BlockSpec((g, 128), lambda i, j, *_: (i, 0)),
             pl.BlockSpec((bq, d), lambda i, j, *_: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((bq, 1), lambda i, j, *_: (i, 0)),
-            pl.BlockSpec((bq, 1), lambda i, j, *_: (i, 0)),
+            pl.BlockSpec((g, 128), lambda i, j, *_: (i, 0)),
+            pl.BlockSpec((g, 128), lambda i, j, *_: (i, 0)),
             pl.BlockSpec((bq, d), lambda i, j, *_: (i, 0)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((g, 128), jnp.float32),
+            pltpu.VMEM((g, 128), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
     )
@@ -343,13 +406,14 @@ def flash_attention_panel(q, k, v, m, l, acc, q_offset, k_offset, valid_len,
         kern,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((sq, 1), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((sq, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((sq // 128, 128), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((sq // 128, 128), jnp.float32, vma=vma),
             jax.ShapeDtypeStruct((sq, d), jnp.float32, vma=vma),
         ],
         interpret=interpret,
-    )(scalars, q, k, v, m, l, acc)
-    return m2, l2, a2
+    )(scalars, q, k, v, m.reshape(sq // 128, 128),
+      l.reshape(sq // 128, 128), acc)
+    return m2.reshape(sq), l2.reshape(sq), a2
 
 
 def flash_attention_single_panel(q, k, v, valid_len, *, causal: bool,
@@ -357,7 +421,11 @@ def flash_attention_single_panel(q, k, v, valid_len, *, causal: bool,
     """Full-sequence attention for one head as ONE flash panel: init the
     (m, l, acc) state, a single :func:`flash_attention_panel` pass over all
     keys, then normalize. Returns ``(out, lse)`` with ``out`` in f32 (callers
-    cast) and ``lse = m + log l`` for custom-vjp backwards.
+    cast) and 1-D ``lse = m + log l`` rows of shape ``(seq,)`` for custom-vjp
+    backwards — 1-D end to end, because a ``(seq, 1)`` f32 array pads 128x
+    under the TPU's (8, 128) tiling, in HBM and in any fusion that stack-
+    allocates it in scoped VMEM (at 32k x heads that padding alone blew the
+    VMEM budget; at 1M panels it was ~0.5 GiB of dead HBM per tensor).
 
     The shared single-panel idiom of ulysses local attention
     (parallel/ulysses.py) and the decode flash prefill
@@ -365,10 +433,10 @@ def flash_attention_single_panel(q, k, v, valid_len, *, causal: bool,
     (the ``_NEG`` sentinel and the 1e-30 denominator floor)."""
     seq, d = q.shape
     b = block_divisor(seq)
-    m = jnp.full((seq, 1), _NEG, jnp.float32)
-    l = jnp.zeros((seq, 1), jnp.float32)
+    m = jnp.full((seq,), _NEG, jnp.float32)
+    l = jnp.zeros((seq,), jnp.float32)
     acc = jnp.zeros((seq, d), jnp.float32)
     m, l, acc = flash_attention_panel(q, k, v, m, l, acc, 0, 0, valid_len,
                                       causal=causal, scale=scale, bq=b, bkv=b)
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
-    return acc / jnp.maximum(l, 1e-30), lse
+    return acc / jnp.maximum(l, 1e-30)[:, None], lse

@@ -105,8 +105,11 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
         b = _block_divisor(min(sq, skv))
         idx = jax.lax.axis_index(axis)
 
-        m = _var(jnp.full((sq, 1), _NEG, jnp.float32))
-        l = _var(jnp.zeros((sq, 1), jnp.float32))
+        # m/l are 1-D (sq,) end to end: the (sq, 1) form tile-pads 128x in
+        # HBM (ops/flash_attention._panel_kernel) — at 1M-token panels that
+        # padding was ~0.5 GiB of dead HBM per tensor per head
+        m = _var(jnp.full((sq,), _NEG, jnp.float32))
+        l = _var(jnp.zeros((sq,), jnp.float32))
         acc = _var(jnp.zeros((sq, d), jnp.float32))
 
         panel = functools.partial(flash_attention_panel, causal=causal,
@@ -138,12 +141,17 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
 
     def local_flash(q_blk, k_blk, v_blk, valid_len):
         m, l, acc = _flash_state(q_blk, k_blk, v_blk, valid_len)
-        return (acc / jnp.maximum(l, 1e-30)).astype(q_blk.dtype)
+        return (acc / jnp.maximum(l, 1e-30)[:, None]).astype(q_blk.dtype)
 
     def local_flash_fwd(q_blk, k_blk, v_blk, valid_len):
         m, l, acc = _flash_state(q_blk, k_blk, v_blk, valid_len)
+        # the saved lse stays 1-D (sq,): a (sq, 1) residual's 1-wide lane dim
+        # pads 128x under TPU (8, 128) tiling — in HBM and the moment a
+        # fusion holds it in scoped VMEM (at 32k tokens x heads that padding
+        # alone exceeded the VMEM budget and the non-remat train step failed
+        # to compile)
         lse = m + jnp.log(jnp.maximum(l, 1e-30))
-        return (acc / jnp.maximum(l, 1e-30)).astype(q_blk.dtype), lse
+        return (acc / jnp.maximum(l, 1e-30)[:, None]).astype(q_blk.dtype), lse
 
     def local_flash_bwd(q_blk, k_blk, v_blk, out_blk, lse_blk, do_blk,
                         valid_len):
@@ -160,8 +168,7 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
         b = _block_divisor(min(sq, skv))
         idx = jax.lax.axis_index(axis)
         do_f = do_blk.astype(jnp.float32)
-        delta = jnp.sum(do_f * out_blk.astype(jnp.float32), axis=-1,
-                        keepdims=True)
+        delta = jnp.sum(do_f * out_blk.astype(jnp.float32), axis=-1)  # (sq,)
         panel_bwd = functools.partial(flash_attention_panel_bwd, causal=causal,
                                       scale=scale, bq=b, bkv=b)
         # home panel first (i = 0), outside the loop: the K/V panels then
@@ -267,12 +274,12 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
     flash_fwd_call = jax.shard_map(
         local_flash_fwd, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(axis, None), P()),
-        out_specs=(P(axis, None), P(axis, None)),
+        out_specs=(P(axis, None), P(axis)),  # lse rows are 1-D (see fwd)
         check_vma=False,
     )
     flash_bwd_call = jax.shard_map(
         local_flash_bwd, mesh=mesh,
-        in_specs=(P(axis, None),) * 6 + (P(),),
+        in_specs=(P(axis, None),) * 4 + (P(axis), P(axis, None), P()),
         out_specs=(P(axis, None),) * 3,
         check_vma=False,
     )
@@ -360,11 +367,12 @@ def ring_attention(
         # valid_len masks the padded keys exactly
         sp = p_size * pad_to_multiple(sp // p_size, _KV_TILE)
     if flash:
-        # flash blocks are power-of-two divisors of the panel length; pad the
-        # panel to a 128 multiple so _block_divisor never degenerates below
-        # the (8, 128) f32 tile Mosaic wants (a 1-wide block grid would be a
-        # compile failure or a perf cliff)
-        sp = p_size * pad_to_multiple(sp // p_size, 128)
+        # the flash block contract (ops/flash_attention.block_divisor):
+        # panels > 1024 pad to 1024 multiples (bq=1024, legal (8, 128)
+        # packed-m/l blocks); shorter panels pad to 128 and run whole
+        panel = sp // p_size
+        sp = p_size * (pad_to_multiple(panel, 1024) if panel > 1024
+                       else pad_to_multiple(panel, 128))
     pad = ((0, 0),) * (q.ndim - 2) + ((0, sp - seq), (0, 0))
     if sp != seq:
         q, k, v = (jnp.pad(x, pad) for x in (q, k, v))

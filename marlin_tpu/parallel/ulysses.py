@@ -67,7 +67,7 @@ def _local_flash_bwd(causal, scale, res, ct):
     q, k, v, out, lse, valid_len = res
     b = block_divisor(q.shape[0])
     delta = jnp.sum(ct.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)
+                    axis=-1)  # 1-D rows, like lse (see flash_attention)
     dq, dk, dv = flash_attention_panel_bwd(
         q, k, v, ct.astype(q.dtype), lse, delta, 0, 0, valid_len,
         causal=causal, scale=scale, bq=b, bkv=b)
@@ -151,8 +151,14 @@ def ulysses_attention(
             f"({p_size}) — pad the head axis or use ring_attention"
         )
     # pad the sequence so both shardings (seq-split slabs and full-seq heads)
-    # are well-formed; flash blocks want a 128-multiple panel
-    sp = p_size * pad_to_multiple(pad_to_multiple(seq, p_size) // p_size, 128)
+    # are well-formed. The full-seq panel follows the flash block contract
+    # (ops/flash_attention.block_divisor): a total length past 1024 must be
+    # a 1024 multiple, so the slab pads to the minimal multiple that makes
+    # p·slab one (1024/gcd(p, 1024)); shorter totals pad the slab to 128
+    slab = pad_to_multiple(pad_to_multiple(seq, p_size) // p_size, 128)
+    if p_size * slab > 1024:
+        slab = pad_to_multiple(slab, 1024 // math.gcd(p_size, 1024))
+    sp = p_size * slab
     if sp != seq:
         pad = ((0, 0), (0, sp - seq), (0, 0))
         q, k, v = (jnp.pad(x, pad) for x in (q, k, v))

@@ -42,24 +42,24 @@ def _compile1(fn, arg_shapes):
 def test_flash_forward_mosaic_compiles():
     from marlin_tpu.ops.flash_attention import flash_attention_panel
 
-    S, D, B = 2048, 128, 512
+    S, D, B = 2048, 128, 1024
     c = _compile1(
         lambda q, k, v, m, l, acc: flash_attention_panel(
             q, k, v, m, l, acc, 0, 0, S, causal=True, scale=0.125,
             bq=B, bkv=B, interpret=False),
-        [(S, D), (S, D), (S, D), (S, 1), (S, 1), (S, D)])
+        [(S, D), (S, D), (S, D), (S,), (S,), (S, D)])
     assert c.memory_analysis().temp_size_in_bytes == 0  # streams via VMEM
 
 
 def test_flash_backward_mosaic_compiles():
     from marlin_tpu.ops.flash_attention import flash_attention_panel_bwd
 
-    S, D, B = 2048, 128, 512
+    S, D, B = 2048, 128, 1024
     c = _compile1(
         lambda q, k, v, do, lse, delta: flash_attention_panel_bwd(
             q, k, v, do, lse, delta, 0, 0, S, causal=True, scale=0.125,
             bq=B, bkv=B, interpret=False),
-        [(S, D), (S, D), (S, D), (S, D), (S, 1), (S, 1)])
+        [(S, D), (S, D), (S, D), (S, D), (S,), (S,)])
     assert c.memory_analysis().temp_size_in_bytes == 0
 
 
@@ -219,3 +219,71 @@ def test_flash_prefill_memory_linear_on_tpu():
     assert p16 < 2.6 * p8, (p8, p16)
     # and nowhere near the dense path's 8.6 GiB of scores
     assert p16 < 2 * 1024**3, p16
+
+
+def test_plan_context_real_compiles():
+    """plan_context against the real compiler: a tiny model at 32k tokens
+    fits a generous budget as-configured, and a deliberately starved budget
+    forces knob escalation whose chosen rung really fits (every number here
+    is the TPU compiler's own accounting, not a heuristic)."""
+    from marlin_tpu.models import TransformerLM, plan_context
+
+    lm = TransformerLM(vocab=256, d_model=64, heads=2, layers=2,
+                       attn="ring_flash")
+    seq = 32768
+    generous = plan_context(seq, lm, hbm_budget=15 * 1024**3)
+    assert generous.fits and generous.knobs == {}
+
+    starved = plan_context(seq, lm, hbm_budget=generous.peak_bytes - 1)
+    assert starved.fits, starved.describe()
+    assert starved.knobs  # at least one knob escalated
+    assert starved.peak_bytes < generous.peak_bytes
+
+
+def test_2m_tokens_single_chip_and_host_offload():
+    """The single-chip context cliff (r4 verdict #5), compiler-verified:
+
+    1. 2M bf16 tokens — a 17-GiB compiler REJECTION before the exact-packed
+       m/l kernel layout — now fit one v5e under *usable* HBM with the
+       on-device knobs alone (remat + loss_chunk + mlp_chunk + bf16).
+    2. offload_residuals genuinely moves the remat checkpoints off the
+       device: ~2 GiB of host temps appear in the compiler's host-memory
+       accounting and the device program still compiles. (At THIS config it
+       is net-neutral — the scan formulation costs about what the offload
+       saves — so it is the knob for residual-dominated shapes, more
+       layers x d_model, not the default.)"""
+    import optax
+
+    from jax.sharding import NamedSharding
+
+    from marlin_tpu.models.planner import _compiled_peak, usable_hbm_bytes
+    from marlin_tpu.models.transformer import TransformerLM, lm_train_step
+
+    mesh = topology_mesh(("rows",), (1,))
+    lm = TransformerLM(vocab=512, d_model=256, heads=2, layers=2,
+                       attn="ring_flash", remat=True, loss_chunk=16384,
+                       compute_dtype="bfloat16", mlp_chunk=16384)
+    peak, note = _compiled_peak(lm, 2097152, mesh)
+    assert peak is not None, note
+    assert peak <= usable_hbm_bytes(), (peak, usable_hbm_bytes())
+
+    import dataclasses
+
+    lm_off = dataclasses.replace(lm, offload_residuals=True)
+    rep = NamedSharding(mesh, P())
+    sds = lambda tree: jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype, sharding=rep),
+        tree)
+    params = jax.eval_shape(lm_off.init_params)
+    opt_state = jax.eval_shape(optax.adam(lm_off.learning_rate).init, params)
+    tokens = jax.ShapeDtypeStruct((2097152,), jnp.int32, sharding=rep)
+    with mt.config_context(pallas_interpret=False):
+        c = lm_train_step.trace(
+            sds(params), sds(opt_state), tokens, mesh, lm_off.heads,
+            lm_off.attn, lm_off.remat, lm_off.precision,
+            lm_off.learning_rate, lm_off.loss_chunk, lm_off.compute_dtype,
+            lm_off.mlp_chunk, lm_off.offload_residuals).lower().compile()
+    ma = c.memory_analysis()
+    # the residuals (2 layers x 2M x 256 x bf16 = 2 GiB) live on the host
+    assert ma.host_temp_size_in_bytes >= 2 * 1024**3
+    assert ma.peak_memory_in_bytes < 16 * 1024**3

@@ -17,13 +17,14 @@ def _qkv(seq, d, seed):
 
 
 def _init_state(sq, d):
-    return (jnp.full((sq, 1), -1e30, jnp.float32),
-            jnp.zeros((sq, 1), jnp.float32),
+    # m/l are 1-D lane-major rows (the (sq, 1) form tile-pads 128x in HBM)
+    return (jnp.full((sq,), -1e30, jnp.float32),
+            jnp.zeros((sq,), jnp.float32),
             jnp.zeros((sq, d), jnp.float32))
 
 
 def _finish(m, l, acc):
-    return np.asarray(acc / jnp.maximum(l, 1e-30))
+    return np.asarray(acc / jnp.maximum(l, 1e-30)[:, None])
 
 
 @pytest.mark.parametrize("causal", [False, True])

@@ -161,6 +161,50 @@ elif MODE in ("elastic_save", "elastic_resume"):
         np.testing.assert_allclose(metrics, oracle[6:], rtol=1e-5, atol=1e-7)
         print(f"proc {proc_id}: elastic resume ok", flush=True)
 
+elif MODE == "latest_writer":
+    # single-writer 'latest' (r4 verdict #8 / ADVICE): through a remote-FS
+    # hook — where concurrent same-object puts are undefined — only proc 0
+    # may write the pointer; the trailing barrier still guarantees every
+    # process sees the flipped pointer before save_checkpoint returns. The
+    # audit FS delegates to the shared local dir (its stand-in for an object
+    # store) and logs every 'latest' write to a per-process file.
+    import fsspec
+    from marlin_tpu.io.fs import register_filesystem, open_path
+    from marlin_tpu.io.checkpoint import save_checkpoint
+
+    audit = os.path.join(ckpt_dir, f"latest_writes_proc{proc_id}")
+
+    class Audited(fsspec.AbstractFileSystem):
+        def _real(self, p):
+            return os.path.join(ckpt_dir, p.split("://", 1)[-1].lstrip("/"))
+        def open(self, p, mode="r", **kw):
+            if p.rstrip("/").rsplit("/", 1)[-1] == "latest" and "w" in mode:
+                with open(audit, "a") as f:
+                    f.write(mode + "\n")
+            if "w" in mode or "a" in mode:
+                os.makedirs(os.path.dirname(self._real(p)), exist_ok=True)
+            return open(self._real(p), mode)
+        def isdir(self, p):
+            return os.path.isdir(self._real(p))
+        def isfile(self, p):
+            return os.path.isfile(self._real(p))
+        def ls(self, p, **kw):
+            return [p.rstrip("/") + "/" + n for n in os.listdir(self._real(p))]
+        def makedirs(self, p, exist_ok=False):
+            os.makedirs(self._real(p), exist_ok=exist_ok)
+
+    register_filesystem("audfs", Audited())
+    # 'a' spans both processes -> per-leaf sharded layout -> barrier + latest
+    save_checkpoint({"w": a}, "audfs://ck", step=3)
+    with open_path("audfs://ck/latest") as f:
+        assert f.read().strip() == "3"  # postcondition holds on EVERY process
+    if proc_id == 0:
+        with open(audit) as f:
+            assert len(f.read().split()) == 1, "proc 0 must write exactly once"
+    else:
+        assert not os.path.exists(audit), f"proc {proc_id} wrote 'latest'"
+    print(f"proc {proc_id}: latest single-writer ok", flush=True)
+
 # Ordered shutdown: the coordinator (proc 0) must outlive the workers — if it
 # dies first, the survivors' coordination-service poll thread fatals on
 # "Socket closed". Workers drop a done-file and exit immediately; the
@@ -267,3 +311,13 @@ def test_process_elastic_1_to_2(tmp_path):
     _launch(tmp_path / "train1", 1, "elastic_save", ckpt, "elastic save ok")
     _launch(tmp_path / "resume2", 2, "elastic_resume", ckpt,
             "elastic resume ok")
+
+
+@pytest.mark.skipif(os.environ.get("MARLIN_SKIP_MULTIHOST") == "1",
+                    reason="multi-host test disabled")
+def test_latest_pointer_single_writer(tmp_path):
+    """save_checkpoint through a remote-FS hook: the 'latest' pointer is
+    written by process 0 alone (object stores make concurrent same-object
+    writes undefined), yet visible to every process before return."""
+    _launch(tmp_path / "run", 2, "latest_writer", tmp_path,
+            "latest single-writer ok")

@@ -1,0 +1,114 @@
+"""plan_context: knob escalation driven by compiler memory accounting.
+
+Ladder/budget logic runs against a fake measurer (fast, deterministic); one
+integration test compiles for real through the AOT channel (libtpu, no chip)
+and lives with the other compile-only evidence in test_aot_tpu.py.
+"""
+
+import json
+
+import pytest
+
+from marlin_tpu.models import TransformerLM, plan_context, usable_hbm_bytes
+from marlin_tpu.models.planner import DEFAULT_RESERVE_BYTES, GIB, _ladder
+
+
+def _measure_table(table):
+    """Fake measurer: peak by frozenset of escalated knob names."""
+    def measure(m):
+        key = frozenset(
+            k for k in ("remat", "loss_chunk", "mlp_chunk", "compute_dtype",
+                        "offload_residuals")
+            if getattr(m, k) not in (None, False))
+        return table[key], ""
+    return measure
+
+
+def test_ladder_is_cumulative_and_respects_preset_knobs():
+    lm = TransformerLM(vocab=64, d_model=32, heads=2, layers=1)
+    rungs = _ladder(lm, seq=100_000)
+    assert rungs[0] == {}
+    assert rungs[1] == {"remat": True}
+    assert rungs[-1] == {"remat": True, "loss_chunk": 16384,
+                         "mlp_chunk": 16384, "compute_dtype": "bfloat16",
+                         "offload_residuals": True}
+    # knobs already set by the user are never re-proposed (or weakened)
+    lm2 = TransformerLM(remat=True, loss_chunk=4096)
+    rungs2 = _ladder(lm2, seq=100_000)
+    assert rungs2 == [{}, {"mlp_chunk": 16384},
+                      {"mlp_chunk": 16384, "compute_dtype": "bfloat16"},
+                      {"mlp_chunk": 16384, "compute_dtype": "bfloat16",
+                       "offload_residuals": True}]
+    # chunk sizes never exceed the sequence
+    assert _ladder(lm, seq=1000)[2]["loss_chunk"] == 1000
+
+
+def test_plan_stops_at_first_fitting_rung():
+    lm = TransformerLM(vocab=64, d_model=32, heads=2, layers=1)
+    table = {frozenset(): 10 * GIB,
+             frozenset({"remat"}): 6 * GIB,
+             frozenset({"remat", "loss_chunk"}): 4 * GIB}
+    plan = plan_context(50_000, lm, hbm_budget=7 * GIB,
+                        measure=_measure_table(table))
+    assert plan.fits and plan.knobs == {"remat": True}
+    assert plan.peak_bytes == 6 * GIB
+    assert plan.model.remat is True and plan.model.loss_chunk is None
+    assert len(plan.trail) == 2  # stopped before probing loss_chunk
+    # the chosen model is the input plus exactly the escalated knobs
+    assert plan.model.d_model == 32 and plan.model.vocab == 64
+    # a generous budget keeps the user's config untouched
+    plan0 = plan_context(50_000, lm, hbm_budget=11 * GIB,
+                         measure=_measure_table(table))
+    assert plan0.fits and plan0.knobs == {} and plan0.model is not None
+    assert plan0.model.remat is False
+
+
+def test_plan_reports_no_fit_with_best_rung():
+    lm = TransformerLM(vocab=64, d_model=32, heads=2, layers=1)
+    table = {
+        frozenset(): 40 * GIB,
+        frozenset({"remat"}): 30 * GIB,
+        frozenset({"remat", "loss_chunk"}): 28 * GIB,
+        frozenset({"remat", "loss_chunk", "mlp_chunk"}): 27 * GIB,
+        frozenset({"remat", "loss_chunk", "mlp_chunk", "compute_dtype"}):
+            18 * GIB,
+        frozenset({"remat", "loss_chunk", "mlp_chunk", "compute_dtype",
+                   "offload_residuals"}): 19 * GIB,  # offload nets NEGATIVE
+    }
+    plan = plan_context(2_000_000, lm, hbm_budget=15 * GIB,
+                        measure=_measure_table(table))
+    assert not plan.fits
+    assert plan.peak_bytes == 18 * GIB  # the best (lowest-peak) rung
+    assert plan.knobs["compute_dtype"] == "bfloat16"
+    assert "offload_residuals" not in plan.knobs  # a worse rung never wins
+    assert len(plan.trail) == 6  # the whole ladder was probed
+    assert "DOES NOT FIT" in plan.describe()
+
+
+def test_usable_hbm_budget_sources(tmp_path):
+    # no on-chip report: raw minus the documented reserve
+    assert usable_hbm_bytes(onchip_report=str(tmp_path / "absent.json")) == \
+        16 * GIB - DEFAULT_RESERVE_BYTES
+    # measured bytes_limit wins when the probe has run
+    rep = tmp_path / "HBM_ONCHIP.json"
+    rep.write_text(json.dumps({"bytes_limit": 14 * GIB}))
+    assert usable_hbm_bytes(onchip_report=str(rep)) == 14 * GIB
+    # a corrupt/zero report falls back to the policy
+    rep.write_text(json.dumps({"bytes_limit": 0}))
+    assert usable_hbm_bytes(onchip_report=str(rep)) == \
+        16 * GIB - DEFAULT_RESERVE_BYTES
+
+
+def test_compile_failure_notes_do_not_abort_the_ladder():
+    lm = TransformerLM(vocab=64, d_model=32, heads=2, layers=1)
+    calls = []
+
+    def measure(m):
+        calls.append(m)
+        if len(calls) == 1:
+            return None, "compile failed: boom"  # e.g. Mosaic rejection
+        return 2 * GIB, ""
+
+    plan = plan_context(50_000, lm, hbm_budget=4 * GIB, measure=measure)
+    assert plan.fits and plan.trail[0][1] is None
+    assert "boom" in plan.trail[0][3]

@@ -103,7 +103,10 @@ def test_ulysses_grad_memory_bounded(mesh):
     import jax
 
     rows = mesh.shape["rows"]
-    seq = rows * 128 * 3 - 7  # pads to a non-_KV_TILE-multiple length
+    # the padded panel must EXCEED the 1024 flash block cap, or the whole
+    # panel legitimately runs as one square block and the assertion below
+    # is vacuous (r5 review); 2041 pads to 2048 -> 1024-blocks, pad path on
+    seq = rows * 1024 + 1024 - 7
     q, k, v = _qkv(rows, seq, 8, 20)
     jaxpr = jax.make_jaxpr(
         lambda q_: jax.grad(
@@ -112,7 +115,10 @@ def test_ulysses_grad_memory_bounded(mesh):
     )(q)
     for m_ in re.finditer(r"f32\[(\d+),(\d+)\]", str(jaxpr)):
         a, b = int(m_.group(1)), int(m_.group(2))
-        assert not (a == b and a >= seq), \
+        # square tiles up to the 1024 flash block cap are VMEM-resident
+        # kernel tiles; what must never appear is a square tensor beyond the
+        # cap — that would be a full score matrix materializing
+        assert not (a == b and a > 1024), \
             f"full ({a},{b}) score tensor in the backward program"
 
 

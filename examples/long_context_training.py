@@ -6,9 +6,12 @@ with Adam. Prints the loss trajectory and tokens/s.
 args: ``<seq len> <steps> [d_model] [heads] [layers] [ring|ulysses] [remat 0|1]
 [loss_chunk] [dtype]`` — ``loss_chunk`` scans the LM head and ``dtype``
 (``bfloat16``) selects mixed-precision activations; together with ``remat``
-these are the knobs that carry 1M+ tokens on one chip (docs/parallelism.md);
-after training, a greedy ``lm_generate`` sample continues the stream from a
-short prompt.
+these are the knobs that carry 1M+ tokens on one chip (docs/parallelism.md).
+Pass ``plan`` in place of the knob tail (``... [ring|ulysses] plan``) to let
+:func:`marlin_tpu.models.plan_context` pick every memory knob from the TPU
+compiler's own accounting (needs libtpu; costs one AOT compile per probed
+rung). After training, a greedy ``lm_generate`` sample continues the stream
+from a short prompt.
 """
 
 import sys
@@ -20,14 +23,19 @@ def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) < 2:
         die("usage: long_context_training <seq len> <steps> [d_model] [heads] "
-            "[layers] [ring|ulysses] [remat 0|1] [loss_chunk] [dtype]")
+            "[layers] [ring|ulysses] [remat 0|1] [loss_chunk] [dtype] "
+            "(or: ... [ring|ulysses] plan)")
     seq = int(argv[0])
     steps = int(argv[1])
     d_model = int(argv[2]) if len(argv) > 2 else 128
     heads = int(argv[3]) if len(argv) > 3 else 8
     layers = int(argv[4]) if len(argv) > 4 else 2
     attn = argv[5] if len(argv) > 5 else "ring"
-    remat = bool(int(argv[6])) if len(argv) > 6 else False
+    use_planner = len(argv) > 6 and argv[6] == "plan"
+    if use_planner and len(argv) > 7:
+        die("'plan' replaces the remaining knob args (the planner picks "
+            "them); drop " + " ".join(argv[7:]))
+    remat = bool(int(argv[6])) if len(argv) > 6 and not use_planner else False
     loss_chunk = int(argv[7]) if len(argv) > 7 else None
     compute_dtype = argv[8] if len(argv) > 8 else None
 
@@ -42,6 +50,27 @@ def main(argv=None):
     lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
                        layers=layers, attn=attn, remat=remat,
                        loss_chunk=loss_chunk, compute_dtype=compute_dtype)
+    if use_planner:
+        from marlin_tpu.models import plan_context
+        from marlin_tpu.models.planner import _TOPOLOGY_FOR_CHIPS
+
+        # certify for the ring the training step actually runs over: the
+        # sequence shards across the mesh "rows" axis, so the plan compiles
+        # the SAME sharded program per chip (knob choices are nonmonotonic
+        # across topologies — docs/parallelism.md)
+        rows = mesh.shape["rows"]
+        chips = rows if rows in _TOPOLOGY_FOR_CHIPS else 1
+        if chips != rows:
+            print(f"(planning single-chip; no compile topology for "
+                  f"{rows}-chip rings)")
+        plan = plan_context(seq, lm, chips=chips)
+        print(plan.describe())
+        if not plan.fits:
+            die("no knob set fits usable HBM — shard over more chips "
+                "(plan_context(chips=...)) or shrink the model")
+        lm = plan.model
+        remat, loss_chunk, compute_dtype = lm.remat, lm.loss_chunk, \
+            lm.compute_dtype
     lm.train(tokens, steps=1, mesh=mesh)  # compile (module-level jit cache)
     t0 = millis()
     params, losses = lm.train(tokens, steps=steps, mesh=mesh)

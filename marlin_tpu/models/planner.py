@@ -150,27 +150,45 @@ def _ladder(model, seq: int):
     return rungs
 
 
+# smallest compile-only v5e topology holding each supported mesh size
+_TOPOLOGY_FOR_CHIPS = {1: "v5e:2x2", 2: "v5e:2x2", 4: "v5e:2x2",
+                       8: "v5e:2x4", 16: "v5e:4x4"}
+
+
 def plan_context(seq: int, model, hbm_budget: int | None = None,
-                 topology_name: str = "v5e:2x2", measure=None):
+                 chips: int = 1, topology_name: str | None = None,
+                 measure=None):
     """Pick the cheapest knob set under which ``model`` trains ``seq`` tokens
-    within ``hbm_budget`` bytes on one chip, by compiler accounting.
+    within ``hbm_budget`` bytes *per chip* on a ``chips``-device ring, by
+    compiler accounting.
 
     ``model`` is a :class:`~marlin_tpu.models.transformer.TransformerLM`
     (its existing knob settings are respected and never weakened).
-    ``hbm_budget`` defaults to :func:`usable_hbm_bytes`. ``measure`` overrides
-    the probe (tests); the default compiles on the compile-only topology and
-    needs libtpu (:func:`marlin_tpu.utils.aot.supports_aot_tpu`).
+    ``hbm_budget`` defaults to :func:`usable_hbm_bytes`. ``chips`` > 1
+    compiles the SAME sharded program the multi-chip runtime executes (the
+    ring over a real v5e topology; ``memory_analysis`` is per device), so a
+    fitting plan certifies the sequence-parallel deployment, not a proxy.
+    ``measure`` overrides the probe (tests); the default compiles on the
+    compile-only topology and needs libtpu
+    (:func:`marlin_tpu.utils.aot.supports_aot_tpu`).
 
     Returns a :class:`ContextPlan`; when nothing fits, the plan carries the
     lowest-peak rung with ``fits=False`` — its ``peak_bytes / budget`` ratio
-    is the chip count the mesh needs (sequence memory shards ~linearly over
-    the ring; AOT_MEMORY.json ``lct_long_4chip``), or see the host-offload
-    path in docs/parallelism.md."""
+    is roughly the factor more chips the mesh needs (sequence memory shards
+    ~linearly over the ring; AOT_MEMORY.json ``lct_long_4chip``), or see the
+    host-offload path in docs/parallelism.md."""
     budget = usable_hbm_bytes() if hbm_budget is None else int(hbm_budget)
     if measure is None:
         from ..utils.aot import topology_mesh
 
-        mesh = topology_mesh(("rows",), (1,), topology_name=topology_name)
+        if topology_name is None:
+            try:
+                topology_name = _TOPOLOGY_FOR_CHIPS[chips]
+            except KeyError:
+                raise ValueError(
+                    f"chips must be one of {sorted(_TOPOLOGY_FOR_CHIPS)} "
+                    "(or pass topology_name explicitly)") from None
+        mesh = topology_mesh(("rows",), (chips,), topology_name=topology_name)
 
         def measure(m):
             return _compiled_peak(m, seq, mesh)

@@ -287,3 +287,20 @@ def test_2m_tokens_single_chip_and_host_offload():
     # the residuals (2 layers x 2M x 256 x bf16 = 2 GiB) live on the host
     assert ma.host_temp_size_in_bytes >= 2 * 1024**3
     assert ma.peak_memory_in_bytes < 16 * 1024**3
+
+
+def test_plan_context_multichip():
+    """chips=4 certifies the SAME sharded ring program per chip: the 4M-token
+    bf16 deployment the docs claim (remat + loss_chunk + bf16, AOT_MEMORY's
+    lct_long_4chip row — NOT mlp_chunk, which measures ~1 GiB WORSE per chip
+    in the sharded program; nonmonotonic knob interactions across topologies
+    are exactly why the planner measures instead of assuming) compiles within
+    per-chip usable HBM."""
+    from marlin_tpu.models import TransformerLM, plan_context
+
+    lm = TransformerLM(vocab=512, d_model=256, heads=2, layers=2,
+                       attn="ring_flash", remat=True, loss_chunk=16384,
+                       compute_dtype="bfloat16")
+    plan = plan_context(4 * 1048576, lm, chips=4)
+    assert plan.fits, plan.describe()
+    assert plan.knobs == {}, plan.knobs  # fits as-documented, no escalation

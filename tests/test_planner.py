@@ -112,3 +112,13 @@ def test_compile_failure_notes_do_not_abort_the_ladder():
     plan = plan_context(50_000, lm, hbm_budget=4 * GIB, measure=measure)
     assert plan.fits and plan.trail[0][1] is None
     assert "boom" in plan.trail[0][3]
+
+
+def test_chips_topology_validation():
+    lm = TransformerLM(vocab=64, d_model=32, heads=2, layers=1)
+    with pytest.raises(ValueError, match="chips"):
+        plan_context(1000, lm, chips=3)
+    # an explicit measure bypasses topology construction entirely
+    plan = plan_context(1000, lm, chips=3, hbm_budget=GIB,
+                        measure=lambda m: (GIB // 2, ""))
+    assert plan.fits

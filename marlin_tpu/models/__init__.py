@@ -17,6 +17,7 @@ from .transformer import (  # noqa: F401
     TransformerLM,
     init_transformer,
     lm_generate,
+    lm_generate_batch,
     lm_loss,
     transformer_forward,
 )

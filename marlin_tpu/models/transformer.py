@@ -25,7 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["TransformerLM", "init_transformer", "transformer_forward",
-           "lm_loss", "lm_train_step", "lm_generate", "synthetic_stream"]
+           "lm_loss", "lm_train_step", "lm_generate", "lm_generate_batch",
+           "synthetic_stream"]
 
 
 def synthetic_stream(seq: int, vocab: int = 64, seed: int = 0,
@@ -295,6 +296,19 @@ def lm_train_step(params, opt_state, tokens, mesh, heads: int, attn: str,
     return optax.apply_updates(params, updates), opt_state, loss
 
 
+def _pick_tokens(temperature, logits, sub):
+    """Greedy at temperature 0, else categorical — over the last axis, so the
+    same helper serves the single-sequence (vocab,) and batched (B, vocab)
+    decode paths (one place for the clamp/sampling contract)."""
+    return jax.lax.cond(
+        temperature > 0.0,
+        lambda: jax.random.categorical(
+            sub, logits / jnp.maximum(temperature, 1e-6),
+            axis=-1).astype(jnp.int32),
+        lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32),
+    )
+
+
 def _decode_step(params, x, caches, pos, heads: int):
     """One cached decode position: ``x`` is the (d_model,) embedded token at
     ``pos`` in the compute dtype (the caches and residual stream follow it);
@@ -377,16 +391,16 @@ def _prefill_attn(q, k, v, cdtype):
     return jnp.moveaxis(o[:, :P], 0, 1).astype(cdtype)
 
 
-def _prefill(params, prompt, heads: int, max_len: int, cdtype):
+def _prefill_hidden(params, prompt, heads: int, max_len: int, cdtype):
     """Process the whole prompt in ONE parallel forward — every projection is
     a (P, d) @ (d, d) MXU matmul and the causal attention is batched (dense
     for short prompts, the flash kernel past :data:`_PREFILL_FLASH_MIN` — see
-    :func:`_prefill_attn`) — returning the final-position logits plus
-    per-layer KV caches (in ``cdtype``) padded to ``max_len``. This is the
-    standard prefill/decode split: the scan in :func:`lm_generate` then runs
-    only for *generated* tokens (the previous formulation decoded the prompt
-    position-by-position, P sequential cache updates that no batch dimension
-    could amortize)."""
+    :func:`_prefill_attn`) — returning the final-norm hidden states (P, d)
+    plus per-layer KV caches (in ``cdtype``) padded to ``max_len``. This is
+    the standard prefill/decode split: the scan in :func:`lm_generate` then
+    runs only for *generated* tokens (the previous formulation decoded the
+    prompt position-by-position, P sequential cache updates that no batch
+    dimension could amortize)."""
     n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
     P = prompt.shape[0]
     d = params["emb"].shape[1]
@@ -405,8 +419,13 @@ def _prefill(params, prompt, heads: int, max_len: int, cdtype):
         caches[f"l{i}"] = tuple(
             jnp.zeros((max_len, heads, dh), cdtype).at[:P].set(t)
             for t in (k, v))
-    logits = _head_logits(_rmsnorm(x[-1], params["ln_f"]), params["emb"])
-    return logits, caches
+    return _rmsnorm(x, params["ln_f"]), caches
+
+
+def _prefill(params, prompt, heads: int, max_len: int, cdtype):
+    """Final-position logits + caches (the single-sequence prefill form)."""
+    x, caches = _prefill_hidden(params, prompt, heads, max_len, cdtype)
+    return _head_logits(x[-1], params["emb"]), caches
 
 
 @functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps",
@@ -431,15 +450,7 @@ def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
             f"({max_len}); raise max_len or shorten the request")
 
     temperature = jnp.asarray(temperature, jnp.float32)
-
-    def pick(logits, sub):
-        return jax.lax.cond(
-            temperature > 0.0,
-            lambda: jax.random.categorical(
-                sub, logits / jnp.maximum(temperature, 1e-6)).astype(jnp.int32),
-            lambda: jnp.argmax(logits).astype(jnp.int32),
-        )
-
+    pick = functools.partial(_pick_tokens, temperature)
     cdtype = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
     logits0, caches = _prefill(params, prompt, heads, max_len, cdtype)
     key, sub = jax.random.split(key)
@@ -460,6 +471,66 @@ def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
     (tokens, _, _), _ = jax.lax.scan(
         step, (tokens0, caches, key), n_prompt + jnp.arange(steps - 1))
     return tokens[: n_prompt + steps]
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps",
+                                             "compute_dtype"))
+def lm_generate_batch(params, prompts, lengths, key, heads: int,
+                      max_len: int, steps: int, temperature=0.0,
+                      compute_dtype: str | None = None):
+    """Batched KV-cached decode: ``prompts`` is (B, P) int32 (rows padded to
+    a common P), ``lengths`` (B,) the true prompt lengths — ragged batches
+    decode together, each row continuing from ITS OWN position. Returns
+    (B, max_len) tokens; row b's generation occupies
+    ``[lengths[b], lengths[b] + steps)`` (positions past that hold the pad).
+
+    Decode throughput is batch-driven — the per-step matmuls are (B, d) @
+    (d, d) MXU work instead of vector-matrix — so this is the serving shape
+    of :func:`lm_generate` (which remains the batch-of-one training-eval
+    form). Prefill vmaps the batched flash/dense prefill; per-row cache
+    validity is positional (row b's decode step t reads cache entries
+    ``<= lengths[b] + t``, so pad entries beyond a short row's length are
+    never attended). ``temperature`` is traced, as in :func:`lm_generate`.
+    """
+    prompts = jnp.asarray(prompts, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    B, P = prompts.shape
+    if P + steps > max_len:
+        raise ValueError(
+            f"padded prompt ({P}) + steps ({steps}) exceeds max_len "
+            f"({max_len}); raise max_len or shorten the request")
+
+    temperature = jnp.asarray(temperature, jnp.float32)
+    pick = functools.partial(_pick_tokens, temperature)
+    cdtype = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
+
+    xs, caches = jax.vmap(
+        lambda p: _prefill_hidden(params, p, heads, max_len, cdtype))(prompts)
+    hlast = jnp.take_along_axis(
+        xs, (lengths - 1)[:, None, None], axis=1)[:, 0]  # (B, d)
+    logits0 = _head_logits(hlast, params["emb"])
+    key, sub = jax.random.split(key)
+    first = pick(logits0, sub)
+    rows = jnp.arange(B)
+    tokens0 = (jnp.zeros((B, max_len), jnp.int32)
+               .at[:, :P].set(prompts).at[rows, lengths].set(first))
+
+    decode = jax.vmap(
+        lambda x, c, pos: _decode_step(params, x, c, pos, heads))
+
+    def step(carry, t):
+        tokens, caches, key = carry
+        pos = lengths + t  # (B,) per-row positions
+        x = params["emb"][tokens[rows, pos]].astype(cdtype)
+        logits, caches = decode(x, caches, pos)
+        key, sub = jax.random.split(key)
+        nxt = pick(logits, sub)
+        tokens = tokens.at[rows, pos + 1].set(nxt)  # pos+1 <= max_len-1
+        return (tokens, caches, key), None
+
+    (tokens, _, _), _ = jax.lax.scan(
+        step, (tokens0, caches, key), jnp.arange(steps - 1))
+    return tokens
 
 
 @dataclasses.dataclass
@@ -543,3 +614,24 @@ class TransformerLM:
                            max_len=max_len, steps=steps,
                            temperature=temperature,
                            compute_dtype=self.compute_dtype)
+
+    def generate_batch(self, params, prompts, steps: int = 32,
+                       max_len: int | None = None, temperature=0.0,
+                       seed: int | None = None):
+        """Batched decode over a LIST of prompts (ragged lengths welcome):
+        pads them to a common length and runs :func:`lm_generate_batch`.
+        Returns a list of 1-D arrays, each ``prompt + steps`` tokens."""
+        lengths = np.array([len(p) for p in prompts], np.int32)
+        P = int(lengths.max())
+        padded = np.zeros((len(prompts), P), np.int32)
+        for i, p in enumerate(prompts):
+            padded[i, : len(p)] = np.asarray(p)
+        if max_len is None:
+            max_len = P + steps
+        key = jax.random.key(self.seed if seed is None else seed)
+        out = lm_generate_batch(params, padded, lengths, key,
+                                heads=self.heads, max_len=max_len,
+                                steps=steps, temperature=temperature,
+                                compute_dtype=self.compute_dtype)
+        out = np.asarray(out)
+        return [out[i, : lengths[i] + steps] for i in range(len(prompts))]

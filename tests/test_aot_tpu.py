@@ -174,6 +174,16 @@ def test_decode_path_compiles_for_v5e():
                           steps=320, temperature=temp).lower().compile()
     assert c.memory_analysis().peak_memory_in_bytes < 2 * 1024**3
 
+    # the batched serving form: 8 ragged rows decode together
+    from marlin_tpu.models.transformer import lm_generate_batch
+
+    prompts = jax.ShapeDtypeStruct((8, 512), jnp.int32, sharding=rep)
+    lengths = jax.ShapeDtypeStruct((8,), jnp.int32, sharding=rep)
+    cb = lm_generate_batch.trace(params, prompts, lengths, key, heads=8,
+                                 max_len=576, steps=64,
+                                 temperature=temp).lower().compile()
+    assert cb.memory_analysis().peak_memory_in_bytes < 4 * 1024**3
+
 
 def test_pallas_matmul_and_masked_fill_mosaic_compile():
     """The remaining two Pallas kernels (tiled MXU matmul, fused pad-mask)

@@ -314,3 +314,25 @@ def test_plan_context_multichip():
     plan = plan_context(4 * 1048576, lm, chips=4)
     assert plan.fits, plan.describe()
     assert plan.knobs == {}, plan.knobs  # fits as-documented, no escalation
+
+
+def test_batched_long_prompt_decode_compiles():
+    """lm_generate_batch with prompts past _PREFILL_FLASH_MIN: the flash
+    prefill kernel under NESTED vmap (batch x heads) must fold into the
+    Mosaic grid and compile — the long-document serving shape."""
+    from marlin_tpu.models.transformer import TransformerLM, lm_generate_batch
+
+    rep = _one_device_sharding()
+    lm = TransformerLM(vocab=4096, d_model=512, heads=8, layers=4, seed=0)
+    params = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype, sharding=rep),
+        jax.eval_shape(lm.init_params))
+    prompts = jax.ShapeDtypeStruct((4, 4096), jnp.int32, sharding=rep)
+    lengths = jax.ShapeDtypeStruct((4,), jnp.int32, sharding=rep)
+    key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype, sharding=rep)
+    temp = jax.ShapeDtypeStruct((), jnp.float32, sharding=rep)
+    with mt.config_context(pallas_interpret=False):
+        c = lm_generate_batch.trace(params, prompts, lengths, key, heads=8,
+                                    max_len=4160, steps=64,
+                                    temperature=temp).lower().compile()
+    assert c.memory_analysis().peak_memory_in_bytes < 2 * 1024**3

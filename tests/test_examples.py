@@ -188,3 +188,12 @@ def test_distributed_training_cli(capsys, tmp_path):
     main(["60", "16", "64", str(tmp_path / "ckpt")])
     out = capsys.readouterr().out
     assert "data-parallel" in out and "accuracy" in out
+
+
+def test_decode_serving_cli(capsys):
+    from examples.decode_serving import main
+
+    outs = main(["3", "12", "4", "32", "2", "1"])
+    assert len(outs) == 3
+    out = capsys.readouterr().out
+    assert "batched" in out and "one-at-a-time" in out

@@ -30,7 +30,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import re
 
 __all__ = ["plan_context", "ContextPlan", "usable_hbm_bytes"]
 
@@ -97,37 +96,18 @@ def _compiled_peak(model, seq: int, mesh) -> tuple[int | None, str]:
     """(peak_bytes, note) for one lm_train_step compile on the AOT topology.
     An over-HBM rejection is a result: the compiler names its own usage,
     which becomes the rung's peak (same contract as tools/aot_report._try)."""
-    import jax
-    import jax.numpy as jnp
-    import optax
-
     from ..config import config_context
-    from .transformer import lm_train_step
+    from ..utils.aot import parse_hbm_oom, trace_lm_train_step
 
-    rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
-
-    def sds(tree):
-        return jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
-                                           sharding=rep), tree)
-
-    params = jax.eval_shape(model.init_params)
-    opt_state = jax.eval_shape(optax.adam(model.learning_rate).init, params)
-    tokens = jax.ShapeDtypeStruct((seq,), jnp.int32, sharding=rep)
     try:
         with config_context(pallas_interpret=False):
-            compiled = lm_train_step.trace(
-                sds(params), sds(opt_state), tokens, mesh, model.heads,
-                model.attn, model.remat, model.precision,
-                model.learning_rate, model.loss_chunk, model.compute_dtype,
-                model.mlp_chunk, model.offload_residuals,
-            ).lower().compile()
+            compiled = trace_lm_train_step(model, seq, mesh) \
+                .lower().compile()
         return compiled.memory_analysis().peak_memory_in_bytes, ""
     except Exception as e:
-        m = re.search(r"Used ([0-9.]+)([GMK]) of [0-9.]+[GMK] hbm", str(e))
-        if m:
-            mult = {"K": 1024, "M": 1024 ** 2, "G": GIB}[m.group(2)]
-            return int(float(m.group(1)) * mult), "compiler rejected (>HBM)"
+        needed = parse_hbm_oom(e)
+        if needed is not None:
+            return needed, "compiler rejected (>HBM)"
         return None, "compile failed: " + str(e).split("\n")[0][:160]
 
 

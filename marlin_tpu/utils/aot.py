@@ -30,7 +30,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["tpu_topology", "topology_mesh", "supports_aot_tpu"]
+__all__ = ["tpu_topology", "topology_mesh", "supports_aot_tpu",
+           "trace_lm_train_step", "parse_hbm_oom"]
 
 
 @functools.lru_cache(maxsize=None)
@@ -71,3 +72,47 @@ def topology_mesh(axis_names: tuple[str, ...], shape: tuple[int, ...],
             f"mesh shape {shape} needs {n} devices; topology "
             f"{topology_name!r} has {devs.size}")
     return Mesh(devs[:n].reshape(shape), axis_names)
+
+
+def trace_lm_train_step(model, seq: int, mesh):
+    """Trace the REAL ``lm_train_step`` for AOT compilation: replicated
+    ``ShapeDtypeStruct`` args over ``mesh`` for a ``TransformerLM`` at
+    ``seq`` tokens — the one arg-plumbing shared by the context planner,
+    ``tools/aot_report.py`` and the compile-only tests (callers ``.lower()
+    .compile()`` the result, usually under
+    ``config_context(pallas_interpret=False)``)."""
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..models.transformer import lm_train_step
+
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    def sds(tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
+                                           sharding=rep), tree)
+
+    params = jax.eval_shape(model.init_params)
+    opt_state = jax.eval_shape(optax.adam(model.learning_rate).init, params)
+    tokens = jax.ShapeDtypeStruct((seq,), jnp.int32, sharding=rep)
+    return lm_train_step.trace(
+        sds(params), sds(opt_state), tokens, mesh, model.heads, model.attn,
+        model.remat, model.precision, model.learning_rate, model.loss_chunk,
+        model.compute_dtype, model.mlp_chunk, model.offload_residuals)
+
+
+def parse_hbm_oom(exc) -> int | None:
+    """Bytes the TPU compiler says it needed, parsed from an over-HBM
+    rejection ("Ran out of memory in hbm ... Used X of Y hbm") — None when
+    the exception is not that rejection. An OOM'd compile is a *result* (the
+    compiler locating the cliff), which is why both the planner and
+    aot_report record it instead of crashing."""
+    import re
+
+    m = re.search(r"Used ([0-9.]+)([GMK]) of [0-9.]+[GMK] hbm", str(exc))
+    if not m:
+        return None
+    mult = {"K": 1024, "M": 1024 ** 2, "G": 1024 ** 3}[m.group(2)]
+    return int(float(m.group(1)) * mult)

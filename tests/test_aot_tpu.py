@@ -262,12 +262,8 @@ def test_2m_tokens_single_chip_and_host_offload():
        is net-neutral — the scan formulation costs about what the offload
        saves — so it is the knob for residual-dominated shapes, more
        layers x d_model, not the default.)"""
-    import optax
-
-    from jax.sharding import NamedSharding
-
     from marlin_tpu.models.planner import _compiled_peak, usable_hbm_bytes
-    from marlin_tpu.models.transformer import TransformerLM, lm_train_step
+    from marlin_tpu.models.transformer import TransformerLM
 
     mesh = topology_mesh(("rows",), (1,))
     lm = TransformerLM(vocab=512, d_model=256, heads=2, layers=2,
@@ -279,20 +275,11 @@ def test_2m_tokens_single_chip_and_host_offload():
 
     import dataclasses
 
+    from marlin_tpu.utils.aot import trace_lm_train_step
+
     lm_off = dataclasses.replace(lm, offload_residuals=True)
-    rep = NamedSharding(mesh, P())
-    sds = lambda tree: jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype, sharding=rep),
-        tree)
-    params = jax.eval_shape(lm_off.init_params)
-    opt_state = jax.eval_shape(optax.adam(lm_off.learning_rate).init, params)
-    tokens = jax.ShapeDtypeStruct((2097152,), jnp.int32, sharding=rep)
     with mt.config_context(pallas_interpret=False):
-        c = lm_train_step.trace(
-            sds(params), sds(opt_state), tokens, mesh, lm_off.heads,
-            lm_off.attn, lm_off.remat, lm_off.precision,
-            lm_off.learning_rate, lm_off.loss_chunk, lm_off.compute_dtype,
-            lm_off.mlp_chunk, lm_off.offload_residuals).lower().compile()
+        c = trace_lm_train_step(lm_off, 2097152, mesh).lower().compile()
     ma = c.memory_analysis()
     # the residuals (2 layers x 2M x 256 x bf16 = 2 GiB) live on the host
     assert ma.host_temp_size_in_bytes >= 2 * 1024**3

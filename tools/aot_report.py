@@ -33,8 +33,7 @@ import numpy as np  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 import marlin_tpu as mt  # noqa: E402
-from marlin_tpu.models.transformer import (  # noqa: E402
-    TransformerLM, lm_train_step)
+from marlin_tpu.models.transformer import TransformerLM  # noqa: E402
 from marlin_tpu.parallel.ring_attention import ring_attention  # noqa: E402
 from marlin_tpu.utils.aot import topology_mesh  # noqa: E402
 
@@ -73,29 +72,15 @@ def lct_train_step(seq: int, mesh, compute_dtype=None,
     """AOT-compile one lct_long training step (same knobs as config_lct_long:
     d256/h2/l2/v512, remat, loss_chunk=16k, ring_flash; optionally the bf16
     activation path, host-offloaded residuals, and the chunked FFN)."""
+    from marlin_tpu.utils.aot import trace_lm_train_step
+
     lm = TransformerLM(vocab=512, d_model=256, heads=2, layers=2,
                       attn="ring_flash", remat=True, loss_chunk=16384,
                       compute_dtype=compute_dtype, mlp_chunk=mlp_chunk,
                       offload_residuals=offload)
-    rep = NamedSharding(mesh, P())
-
-    def sds(tree):
-        return jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype, sharding=rep),
-            tree)
-
-    import optax
-    params = jax.eval_shape(lm.init_params)
-    opt_state = jax.eval_shape(optax.adam(lm.learning_rate).init, params)
-    tokens = jax.ShapeDtypeStruct((seq,), jnp.int32, sharding=rep)
-
     t0 = time.time()
     with mt.config_context(pallas_interpret=False):
-        compiled = lm_train_step.trace(
-            sds(params), sds(opt_state), tokens, mesh, lm.heads, lm.attn,
-            lm.remat, lm.precision, lm.learning_rate, lm.loss_chunk,
-            lm.compute_dtype, lm.mlp_chunk, lm.offload_residuals,
-        ).lower().compile()
+        compiled = trace_lm_train_step(lm, seq, mesh).lower().compile()
     out = _mem(compiled)
     out["compile_s"] = round(time.time() - t0, 1)
     return out
@@ -191,16 +176,16 @@ def main(seqs):
 def _try(fn, seq, mesh) -> dict:
     """An over-HBM configuration is a *result* (the compiler locating the
     cliff), not a tool crash: record the compiler's own accounting."""
-    import re
+    from marlin_tpu.utils.aot import parse_hbm_oom
 
     try:
         return fn(seq, mesh)
     except Exception as e:
-        m = re.search(r"Used ([0-9.]+[GMK]) of ([0-9.]+[GMK]) hbm", str(e))
+        needed = parse_hbm_oom(e)
         return {
             "fits_16gib": False,
-            "error": (f"compiler: needs {m.group(1)} HBM of {m.group(2)}"
-                      if m else str(e).split("\n")[0][:200]),
+            "error": (f"compiler: needs {needed / GIB:.2f}G HBM"
+                      if needed else str(e).split("\n")[0][:200]),
         }
 
 

@@ -296,17 +296,41 @@ def lm_train_step(params, opt_state, tokens, mesh, heads: int, attn: str,
     return optax.apply_updates(params, updates), opt_state, loss
 
 
-def _pick_tokens(temperature, logits, sub):
-    """Greedy at temperature 0, else categorical — over the last axis, so the
-    same helper serves the single-sequence (vocab,) and batched (B, vocab)
-    decode paths (one place for the clamp/sampling contract)."""
+def _pick_tokens(temperature, top_p, top_k, logits, sub):
+    """Greedy at temperature 0, else top-k -> nucleus (top-p) -> categorical
+    over the last axis, so the same helper serves the single-sequence
+    (vocab,) and batched (B, vocab) decode paths (one place for the
+    clamp/sampling contract). ``top_k`` is static (shapes ``lax.top_k``) and
+    ``top_p=None`` statically disables the nucleus filter — the default
+    sampling path compiles with no sort; a float ``top_p`` and
+    ``temperature`` are traced, so sweeping either reuses one compiled
+    program. Both filters run only on the sampled branch (the greedy argmax
+    cannot be changed by them)."""
+
+    def sample():
+        l = logits / jnp.maximum(temperature, 1e-6)
+        if top_k is not None:
+            kth = jax.lax.top_k(l, top_k)[0][..., -1:]
+            l = jnp.where(l < kth, -jnp.inf, l)
+        if top_p is not None:
+            # nucleus by RANK, not value: keep the smallest prefix of
+            # descending-probability tokens whose exclusive cumulative mass
+            # is < top_p (the boundary-crossing token stays, so the set is
+            # never empty), then scatter the rank mask back through the
+            # inverse permutation — a value cutoff would keep every token
+            # TIED with the boundary and silently widen the nucleus
+            order = jnp.argsort(-l, axis=-1)  # stable: first max stays first
+            srt = jnp.take_along_axis(l, order, axis=-1)
+            probs = jax.nn.softmax(srt, axis=-1)
+            keep_sorted = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+            inv = jnp.argsort(order, axis=-1)
+            keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+            l = jnp.where(keep, l, -jnp.inf)
+        return jax.random.categorical(sub, l, axis=-1).astype(jnp.int32)
+
     return jax.lax.cond(
-        temperature > 0.0,
-        lambda: jax.random.categorical(
-            sub, logits / jnp.maximum(temperature, 1e-6),
-            axis=-1).astype(jnp.int32),
-        lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32),
-    )
+        temperature > 0.0, sample,
+        lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32))
 
 
 def _decode_step(params, x, caches, pos, heads: int):
@@ -428,29 +452,47 @@ def _prefill(params, prompt, heads: int, max_len: int, cdtype):
     return _head_logits(x[-1], params["emb"]), caches
 
 
-@functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps",
-                                             "compute_dtype"))
 def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
-                temperature=0.0, compute_dtype: str | None = None):
+                temperature=0.0, compute_dtype: str | None = None,
+                top_p=None, top_k: int | None = None):
     """KV-cached autoregressive decode: batched prefill of the prompt (one
     parallel forward, :func:`_prefill`), then one ``lax.scan`` sampling
     ``steps`` tokens — the whole generation is a single XLA program.
 
-    ``temperature`` is a *traced* scalar (greedy at 0): sweeping sampling
-    settings reuses one compiled program instead of recompiling per value
-    (round-3 verdict #7). ``compute_dtype`` (e.g. "bfloat16") runs the
-    residual stream AND the KV caches in that dtype — at decode the caches
-    ARE the memory, so this halves cache HBM; logits/softmax stay f32.
-    Defaults to the params dtype."""
-    prompt = jnp.asarray(prompt, jnp.int32)
+    ``temperature`` (and ``top_p``, once set to a float) are *traced*
+    scalars (greedy at temperature 0; nucleus sampling when ``top_p`` is
+    given): sweeping sampling settings reuses one compiled program instead
+    of recompiling per value (round-3 verdict #7). ``top_k`` is static (it
+    shapes ``lax.top_k``); ``top_p=None`` statically omits the nucleus sort
+    from the program (None vs float is a one-time recompile — the sort
+    either exists in the program or doesn't).
+    ``compute_dtype`` (e.g. "bfloat16") runs the residual stream AND the KV
+    caches in that dtype — at decode the caches ARE the memory, so this
+    halves cache HBM; logits/softmax stay f32. Defaults to the params
+    dtype."""
+    return _lm_generate_jit(
+        params, jnp.asarray(prompt, jnp.int32), key, heads=heads,
+        max_len=max_len, steps=steps,
+        temperature=jnp.asarray(temperature, jnp.float32),
+        compute_dtype=compute_dtype,
+        top_p=jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+        use_top_p=top_p is not None, top_k=top_k)
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps",
+                                             "compute_dtype", "use_top_p",
+                                             "top_k"))
+def _lm_generate_jit(params, prompt, key, heads: int, max_len: int,
+                     steps: int, temperature, compute_dtype,
+                     top_p, use_top_p: bool, top_k: int | None):
     n_prompt = prompt.shape[0]
     if n_prompt + steps > max_len:
         raise ValueError(
             f"prompt ({n_prompt}) + steps ({steps}) exceeds max_len "
             f"({max_len}); raise max_len or shorten the request")
 
-    temperature = jnp.asarray(temperature, jnp.float32)
-    pick = functools.partial(_pick_tokens, temperature)
+    pick = functools.partial(_pick_tokens, temperature,
+                             top_p if use_top_p else None, top_k)
     cdtype = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
     logits0, caches = _prefill(params, prompt, heads, max_len, cdtype)
     key, sub = jax.random.split(key)
@@ -473,11 +515,10 @@ def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
     return tokens[: n_prompt + steps]
 
 
-@functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps",
-                                             "compute_dtype"))
 def lm_generate_batch(params, prompts, lengths, key, heads: int,
                       max_len: int, steps: int, temperature=0.0,
-                      compute_dtype: str | None = None):
+                      compute_dtype: str | None = None,
+                      top_p=None, top_k: int | None = None):
     """Batched KV-cached decode: ``prompts`` is (B, P) int32 (rows padded to
     a common P), ``lengths`` (B,) the true prompt lengths — ragged batches
     decode together, each row continuing from ITS OWN position. Returns
@@ -490,18 +531,34 @@ def lm_generate_batch(params, prompts, lengths, key, heads: int,
     form). Prefill vmaps the batched flash/dense prefill; per-row cache
     validity is positional (row b's decode step t reads cache entries
     ``<= lengths[b] + t``, so pad entries beyond a short row's length are
-    never attended). ``temperature`` is traced, as in :func:`lm_generate`.
+    never attended). Sampling knobs as in :func:`lm_generate`
+    (``temperature``/``top_p`` traced, ``top_k`` static, ``top_p=None``
+    statically sort-free).
     """
-    prompts = jnp.asarray(prompts, jnp.int32)
-    lengths = jnp.asarray(lengths, jnp.int32)
+    return _lm_generate_batch_jit(
+        params, jnp.asarray(prompts, jnp.int32),
+        jnp.asarray(lengths, jnp.int32), key, heads=heads, max_len=max_len,
+        steps=steps, temperature=jnp.asarray(temperature, jnp.float32),
+        compute_dtype=compute_dtype,
+        top_p=jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+        use_top_p=top_p is not None, top_k=top_k)
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps",
+                                             "compute_dtype", "use_top_p",
+                                             "top_k"))
+def _lm_generate_batch_jit(params, prompts, lengths, key, heads: int,
+                           max_len: int, steps: int, temperature,
+                           compute_dtype, top_p, use_top_p: bool,
+                           top_k: int | None):
     B, P = prompts.shape
     if P + steps > max_len:
         raise ValueError(
             f"padded prompt ({P}) + steps ({steps}) exceeds max_len "
             f"({max_len}); raise max_len or shorten the request")
 
-    temperature = jnp.asarray(temperature, jnp.float32)
-    pick = functools.partial(_pick_tokens, temperature)
+    pick = functools.partial(_pick_tokens, temperature,
+                             top_p if use_top_p else None, top_k)
     cdtype = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
 
     xs, caches = jax.vmap(
@@ -531,6 +588,16 @@ def lm_generate_batch(params, prompts, lengths, key, heads: int,
     (tokens, _, _), _ = jax.lax.scan(
         step, (tokens0, caches, key), jnp.arange(steps - 1))
     return tokens
+
+
+# forward the private jit cache-size probe through the un-jitted shims (the
+# no-recompile tests/benches read it; getattr-guarded everywhere, so its
+# absence on a future JAX merely skips those checks)
+for _pub, _jit in ((lm_generate, _lm_generate_jit),
+                   (lm_generate_batch, _lm_generate_batch_jit)):
+    if hasattr(_jit, "_cache_size"):
+        _pub._cache_size = _jit._cache_size
+del _pub, _jit
 
 
 @dataclasses.dataclass
@@ -603,20 +670,23 @@ class TransformerLM:
 
     def generate(self, params, prompt, steps: int = 32,
                  max_len: int | None = None, temperature=0.0,
+                 top_p=None, top_k: int | None = None,
                  seed: int | None = None):
         """Sample ``steps`` tokens continuing ``prompt`` with the params
         returned by :meth:`train` (see :func:`lm_generate`; ``temperature``
-        is traced — sweeping it reuses one compiled program)."""
+        and ``top_p`` are traced — sweeping them reuses one compiled
+        program)."""
         key = jax.random.key(self.seed if seed is None else seed)
         if max_len is None:
             max_len = len(prompt) + steps
         return lm_generate(params, prompt, key, heads=self.heads,
                            max_len=max_len, steps=steps,
-                           temperature=temperature,
+                           temperature=temperature, top_p=top_p, top_k=top_k,
                            compute_dtype=self.compute_dtype)
 
     def generate_batch(self, params, prompts, steps: int = 32,
                        max_len: int | None = None, temperature=0.0,
+                       top_p=None, top_k: int | None = None,
                        seed: int | None = None):
         """Batched decode over a LIST of prompts (ragged lengths welcome):
         pads them to a common length and runs :func:`lm_generate_batch`.
@@ -632,6 +702,7 @@ class TransformerLM:
         out = lm_generate_batch(params, padded, lengths, key,
                                 heads=self.heads, max_len=max_len,
                                 steps=steps, temperature=temperature,
+                                top_p=top_p, top_k=top_k,
                                 compute_dtype=self.compute_dtype)
         out = np.asarray(out)
         return [out[i, : lengths[i] + steps] for i in range(len(prompts))]

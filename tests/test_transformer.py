@@ -449,3 +449,69 @@ def test_generate_batch_facade(mesh):
     outs = lm.generate_batch(p, [[1, 2, 3], [4, 5]], steps=4)
     assert [len(o) for o in outs] == [7, 6]
     assert outs[0][:3].tolist() == [1, 2, 3] and outs[1][:2].tolist() == [4, 5]
+
+
+def test_topk_topp_sampling(mesh):
+    """top-k / nucleus sampling contracts: top_k=1 and a vanishing top_p
+    each force the argmax even at high temperature (so they must equal
+    greedy), defaults are exact no-ops, and sweeping the traced top_p never
+    recompiles."""
+    import jax
+
+    lm = TransformerLM(vocab=32, d_model=16, heads=2, layers=1, seed=11)
+    p = lm.init_params()
+    prompt = np.array([3, 1, 4], np.int32)
+
+    def gen(**kw):
+        return np.asarray(lm_generate(p, prompt, jax.random.key(2), heads=2,
+                                      max_len=16, steps=6, **kw))
+
+    greedy = gen()
+    assert gen(temperature=5.0, top_k=1).tolist() == greedy.tolist()
+    assert gen(temperature=5.0, top_p=1e-6).tolist() == greedy.tolist()
+    # top_p=1.0 keeps every token: _pick_tokens must equal the plain
+    # categorical over the same logits/key (a direct oracle — comparing two
+    # identical lm_generate calls would be vacuous)
+    from marlin_tpu.models.transformer import _pick_tokens
+
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.standard_normal((5, 32)).astype(np.float32))
+    for key_i in range(3):
+        sub = jax.random.key(key_i)
+        got = _pick_tokens(jnp.float32(1.0), jnp.float32(1.0), None,
+                           logits, sub)
+        want = jax.random.categorical(sub, logits, axis=-1)
+        assert np.asarray(got).tolist() == np.asarray(want).tolist(), key_i
+    # nucleus handles TIES by rank, not value: 4 equal max logits (prob
+    # ~0.25 each). top_p=0.2 keeps exactly ONE (rank 0; exclusive mass 0.25
+    # >= 0.2 cuts rank 1) and top_p=0.3 exactly TWO — a value cutoff would
+    # keep all 4 tied tokens in both cases
+    tied = jnp.asarray(np.array([[5.0, 5.0, 5.0, 5.0] + [-20.0] * 28],
+                                np.float32))
+
+    def picks(tp):
+        return {int(_pick_tokens(jnp.float32(1.0), jnp.float32(tp), None,
+                                 tied, jax.random.key(k))[0])
+                for k in range(24)}
+
+    assert len(picks(0.2)) == 1, picks(0.2)
+    assert picks(0.3) <= {0, 1} and len(picks(0.3)) == 2, picks(0.3)
+    # traced top_p: a sweep reuses one compiled program (the FIRST float
+    # top_p legitimately compiles the with-nucleus variant — top_p=None is
+    # a statically different, sort-free program — so warm it before counting)
+    gen(temperature=1.0, top_p=0.5)
+    cache_size = getattr(lm_generate, "_cache_size", None)
+    if cache_size is not None:
+        n0 = cache_size()
+        for tp in (0.3, 0.6, 0.95):
+            gen(temperature=1.0, top_p=tp)
+        assert cache_size() == n0, "top_p sweep recompiled"
+    # batched path honors the same contract
+    from marlin_tpu.models import lm_generate_batch
+
+    prompts = np.stack([prompt, prompt])
+    out = np.asarray(lm_generate_batch(
+        p, prompts, np.full(2, 3, np.int32), jax.random.key(2), heads=2,
+        max_len=16, steps=6, temperature=5.0, top_k=1))
+    for b in range(2):
+        assert out[b, :9].tolist() == greedy.tolist()

@@ -42,9 +42,22 @@ def synthetic_stream(seq: int, vocab: int = 64, seed: int = 0,
 
 
 def init_transformer(key, vocab: int, d_model: int, heads: int, layers: int,
-                     d_ff: int | None = None, dtype=jnp.float32) -> dict:
-    """Scaled-normal init; tied input/output embedding."""
+                     d_ff: int | None = None, dtype=jnp.float32,
+                     kv_heads: int | None = None) -> dict:
+    """Scaled-normal init; tied input/output embedding. ``kv_heads`` enables
+    grouped-query attention: ``heads // kv_heads`` query heads share one K/V
+    head (wk/wv project to ``kv_heads·dh``), which divides the decode KV
+    cache — THE decode memory — and the K/V projection params/FLOPs by the
+    group factor. (Training-time attention broadcasts K/V back to the query
+    head count inside the block, so the in-attention activations stay
+    full-size there — the knob is a serving lever.) Every consumer derives
+    the K/V head count from the parameter shapes, so GQA needs no signature
+    changes anywhere downstream."""
     d_ff = d_ff or 4 * d_model
+    kvh = heads if kv_heads is None else kv_heads
+    if kvh < 1 or heads % kvh:
+        raise ValueError(f"kv_heads ({kvh}) must divide heads ({heads})")
+    kv_dim = (d_model // heads) * kvh
     ks = jax.random.split(key, 2 + 6 * layers)
     p = {"emb": jax.random.normal(ks[0], (vocab, d_model), dtype) * 0.02}
     for i in range(layers):
@@ -52,8 +65,8 @@ def init_transformer(key, vocab: int, d_model: int, heads: int, layers: int,
         s = 1.0 / math.sqrt(d_model)
         p[f"l{i}"] = {
             "wq": jax.random.normal(k[0], (d_model, d_model), dtype) * s,
-            "wk": jax.random.normal(k[1], (d_model, d_model), dtype) * s,
-            "wv": jax.random.normal(k[2], (d_model, d_model), dtype) * s,
+            "wk": jax.random.normal(k[1], (d_model, kv_dim), dtype) * s,
+            "wv": jax.random.normal(k[2], (d_model, kv_dim), dtype) * s,
             "wo": jax.random.normal(k[3], (d_model, d_model), dtype) * s,
             "w1": jax.random.normal(k[4], (d_model, d_ff), dtype) * s,
             "w2": jax.random.normal(k[5], (d_ff, d_model), dtype) / math.sqrt(d_ff),
@@ -118,9 +131,17 @@ def _block(lp, x, heads: int, mesh, attn: str, precision: str,
     h = _rmsnorm(x, lp["ln1"])
 
     def split_heads(w):
-        return (h @ w.astype(cd)).reshape(seq, heads, dh).transpose(1, 0, 2)
+        nh = w.shape[1] // dh  # kv_heads < heads under GQA (init_transformer)
+        return (h @ w.astype(cd)).reshape(seq, nh, dh).transpose(1, 0, 2)
 
     q, k, v = split_heads(lp["wq"]), split_heads(lp["wk"]), split_heads(lp["wv"])
+    if k.shape[0] != heads:
+        # GQA: each group of query heads attends to its shared K/V head —
+        # broadcast K/V up to the query head count for the attention engines
+        # (the softmax math is exactly MQA/GQA; the projection/cache savings
+        # happened above, at the wk/wv matmuls)
+        group = heads // k.shape[0]
+        k, v = (jnp.repeat(t, group, axis=0) for t in (k, v))
     if attn in _ATTN_BACKENDS:
         o = ring_attention(q, k, v, mesh, causal=True, precision=precision,
                            backend=_ATTN_BACKENDS[attn])
@@ -323,6 +344,11 @@ def _pick_tokens(temperature, top_p, top_k, logits, sub):
             srt = jnp.take_along_axis(l, order, axis=-1)
             probs = jax.nn.softmax(srt, axis=-1)
             keep_sorted = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+            # rank 0 is force-kept: at top_p=0.0 (a traced sweep endpoint no
+            # trace-time check can reject) the exclusive-mass test would
+            # empty the set and categorical over all -inf degenerates to
+            # token 0 — top_p→0 must mean greedy, not garbage
+            keep_sorted = keep_sorted.at[..., 0].set(True)
             inv = jnp.argsort(order, axis=-1)
             keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
             l = jnp.where(keep, l, -jnp.inf)
@@ -336,10 +362,13 @@ def _pick_tokens(temperature, top_p, top_k, logits, sub):
 def _decode_step(params, x, caches, pos, heads: int):
     """One cached decode position: ``x`` is the (d_model,) embedded token at
     ``pos`` in the compute dtype (the caches and residual stream follow it);
-    ``caches`` maps layer -> (k, v) of shape (max_len, heads, dh).
-    Attention reads the cache prefix via position masking (static shapes —
-    the scan-friendly decode form of the causal mask); scores/softmax are
-    f32."""
+    ``caches`` maps layer -> (k, v) of shape (max_len, kv_heads, dh) —
+    ``kv_heads < heads`` under GQA, where the cache IS the decode memory and
+    shrinks by the group factor. Attention runs in the grouped form
+    (kv_heads, group, ...) with group = heads // kv_heads (plain MHA is the
+    group=1 case); the cache prefix is read via position masking (static
+    shapes — the scan-friendly decode form of the causal mask);
+    scores/softmax are f32."""
     n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
     cd = x.dtype
     new_caches = {}
@@ -348,18 +377,19 @@ def _decode_step(params, x, caches, pos, heads: int):
         ck, cv = caches[f"l{i}"]
         d = x.shape[-1]
         dh = d // heads
+        kvh = ck.shape[1]
         h = _rmsnorm(x, lp["ln1"])
-        q = (h @ lp["wq"].astype(cd)).reshape(heads, dh)
-        k = (h @ lp["wk"].astype(cd)).reshape(heads, dh)
-        v = (h @ lp["wv"].astype(cd)).reshape(heads, dh)
+        q = (h @ lp["wq"].astype(cd)).reshape(kvh, heads // kvh, dh)
+        k = (h @ lp["wk"].astype(cd)).reshape(kvh, dh)
+        v = (h @ lp["wv"].astype(cd)).reshape(kvh, dh)
         ck = jax.lax.dynamic_update_index_in_dim(ck, k.astype(ck.dtype), pos, 0)
         cv = jax.lax.dynamic_update_index_in_dim(cv, v.astype(cv.dtype), pos, 0)
-        s = jnp.einsum("hd,thd->ht", q, ck,
+        s = jnp.einsum("kgd,tkd->kgt", q, ck,
                        preferred_element_type=jnp.float32) / math.sqrt(dh)
         live = jnp.arange(ck.shape[0]) <= pos
-        s = jnp.where(live[None, :], s, -1e30)
+        s = jnp.where(live[None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("ht,thd->hd", p.astype(cd), cv).reshape(d) \
+        o = jnp.einsum("kgt,tkd->kgd", p.astype(cd), cv).reshape(d) \
             @ lp["wo"].astype(cd)
         x = x + o
         h = _rmsnorm(x, lp["ln2"])
@@ -433,16 +463,22 @@ def _prefill_hidden(params, prompt, heads: int, max_len: int, cdtype):
     caches = {}
     for i in range(n_layers):
         lp = params[f"l{i}"]
+        kvh = lp["wk"].shape[1] // dh  # kv_heads < heads under GQA
         h = _rmsnorm(x, lp["ln1"])
-        q, k, v = (jnp.reshape(h @ lp[w].astype(cdtype), (P, heads, dh))
-                   for w in ("wq", "wk", "wv"))
+        q = jnp.reshape(h @ lp["wq"].astype(cdtype), (P, heads, dh))
+        k, v = (jnp.reshape(h @ lp[w].astype(cdtype), (P, kvh, dh))
+                for w in ("wk", "wv"))
+        # caches hold the UNREPEATED kv_heads (the GQA decode-memory win);
+        # attention sees the group-broadcast form, as in _block
+        caches[f"l{i}"] = tuple(
+            jnp.zeros((max_len, kvh, dh), cdtype).at[:P].set(t)
+            for t in (k, v))
+        if kvh != heads:
+            k, v = (jnp.repeat(t, heads // kvh, axis=1) for t in (k, v))
         o = _prefill_attn(q, k, v, cdtype)
         x = x + o.reshape(P, d) @ lp["wo"].astype(cdtype)
         h = _rmsnorm(x, lp["ln2"])
         x = x + jax.nn.gelu(h @ lp["w1"].astype(cdtype)) @ lp["w2"].astype(cdtype)
-        caches[f"l{i}"] = tuple(
-            jnp.zeros((max_len, heads, dh), cdtype).at[:P].set(t)
-            for t in (k, v))
     return _rmsnorm(x, params["ln_f"]), caches
 
 
@@ -631,11 +667,16 @@ class TransformerLM:
     # back, so at small L·d it is net-neutral (AOT_MEMORY.json
     # lct_long_bf16_offload). Requires remat=True.
     offload_residuals: bool = False
+    # grouped-query attention: heads//kv_heads query heads share one K/V
+    # head, dividing the decode KV cache (and the K/V projections) by the
+    # group factor — the serving memory lever. None = standard MHA. Every
+    # downstream consumer derives it from the parameter shapes.
+    kv_heads: int | None = None
 
     def init_params(self, dtype=jnp.float32) -> dict:
         return init_transformer(jax.random.key(self.seed), self.vocab,
                                 self.d_model, self.heads, self.layers,
-                                self.d_ff, dtype)
+                                self.d_ff, dtype, self.kv_heads)
 
     def train(self, tokens, steps: int = 20, mesh=None, params=None,
               checkpoint_dir: str | None = None, checkpoint_every: int = 0,

@@ -5,8 +5,10 @@ continues from its own prompt length, per-step matmuls are (B, d) MXU work —
 and report batched vs one-at-a-time throughput.
 
 args: ``<batch size> <prompt len> <steps> [d_model] [heads] [layers]
-[temperature]`` — rows get staggered prompt lengths around ``prompt len``
-so the ragged path (per-row positions) really runs.
+[temperature] [kv_heads]`` — rows get staggered prompt lengths around
+``prompt len`` so the ragged path (per-row positions) really runs;
+``kv_heads`` enables grouped-query attention (the KV cache — THE decode
+memory — shrinks by ``heads/kv_heads``).
 """
 
 import sys
@@ -18,7 +20,7 @@ def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) < 3:
         die("usage: decode_serving <batch size> <prompt len> <steps> "
-            "[d_model] [heads] [layers] [temperature]")
+            "[d_model] [heads] [layers] [temperature] [kv_heads]")
     batch = int(argv[0])
     prompt_len = int(argv[1])
     steps = int(argv[2])
@@ -26,6 +28,7 @@ def main(argv=None):
     heads = int(argv[4]) if len(argv) > 4 else 8
     layers = int(argv[5]) if len(argv) > 5 else 2
     temperature = float(argv[6]) if len(argv) > 6 else 0.0
+    kv_heads = int(argv[7]) if len(argv) > 7 else None
     if prompt_len < batch:
         die("prompt len must be >= batch size (rows stagger by one token)")
 
@@ -37,7 +40,7 @@ def main(argv=None):
 
     vocab, period = 512, 16
     lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
-                       layers=layers, learning_rate=3e-3)
+                       layers=layers, learning_rate=3e-3, kv_heads=kv_heads)
     stream = synthetic_stream(max(4096, 4 * prompt_len), vocab=vocab,
                               period=period, step=7, noise=0.05)
     params, losses = lm.train(stream, steps=30)

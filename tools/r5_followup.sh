@@ -1,0 +1,77 @@
+#!/bin/bash
+# Round-5 follow-up batch: the flash-gated configs the main recovery batch
+# skipped because tpu_smoke.py had a sys.path bug (fixed) at the moment the
+# relay came back. Waits for the main batch (and any other TPU client) to
+# exit, then re-probes the relay, re-runs the smoke, and on pass runs the
+# skipped legs.
+#
+# Same discipline as on_recovery.sh: one TPU client at a time, no kills,
+# no timed phase under CPU contention, no batch on a CPU-fallback backend.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/r5_followup.log
+exec >>"$LOG" 2>&1
+
+# single-instance guard: a double nohup-launch must not yield two batches
+exec 9>/tmp/r5_followup.lock
+flock -n 9 || { echo "another r5_followup instance holds the lock; exiting"; exit 0; }
+
+ts() { date -u +%H:%M:%S; }
+
+other_tpu_clients() {
+  # same matcher as on_recovery.sh's tpu_clients(): orphaned "import jax"
+  # probes and standalone smoke runs ARE lease-claiming clients; only the
+  # build driver (whose prompt embeds these names) and this script's own
+  # grep are excluded.
+  pgrep -af "import jax|on_recovery|bench\.py|bench_all\.py|tpu_smoke|hbm_probe" \
+    2>/dev/null | grep -v "claude -p" | grep -v "r5_followup" | grep -q .
+}
+cpu_load() {
+  pgrep -af "pytest" 2>/dev/null | grep -v "claude -p" | grep -q .
+}
+
+# one combined gate, re-evaluated as a unit immediately before the probe:
+# a TPU client appearing during a long cpu_load wait must re-block the batch
+while other_tpu_clients || cpu_load; do
+  echo "$(ts) waiting: tpu_client=$(other_tpu_clients && echo yes || echo no) cpu_load=$(cpu_load && echo yes || echo no)"
+  sleep 60
+done
+
+# Relay-alive gate (same as on_recovery.sh): tpu_smoke exits 0 on a CPU
+# fallback by design, so it must NOT be the only gate — a re-wedged relay
+# would send the 256k-1M legs to CPU where they hang for days or record
+# garbage numbers.
+echo "$(ts) probing relay"
+out=$(python -c "import jax; d = jax.devices(); print('NDEV', len(d), d[0].platform)" 2>&1 | grep -E "NDEV|Error" | tail -1)
+echo "$(ts) probe: $out"
+case "$out" in
+  NDEV*cpu*) echo "$(ts) cpu fallback — relay re-wedged; aborting followup"; exit 1 ;;
+  NDEV*) ;;
+  *) echo "$(ts) probe failed — aborting followup"; exit 1 ;;
+esac
+
+export MARLIN_BENCH_ROUND=r5
+echo "$(ts) follow-up batch starts"
+
+echo "$(ts) [1] pallas kernel smoke (sys.path fixed)"
+if ! python tools/tpu_smoke.py; then
+  echo "$(ts) SMOKE FAILED — flash kernels do not run on this chip; stopping"
+  exit 1
+fi
+
+echo "$(ts) [2] decode prompt sweep (flash prefill legs; re-runs the whole"
+echo "         decode config — BENCH_ALL entries are keyed, latest wins)"
+python bench_all.py decode
+
+echo "$(ts) [3] long-context: lct_long + attn_long at 256k"
+python bench_all.py lct_long attn_long
+
+echo "$(ts) [4] escalation: 512k"
+MARLIN_BENCH_LCT_SEQ=524288 MARLIN_BENCH_ATTN_SEQ=524288 \
+  python bench_all.py lct_long attn_long
+
+echo "$(ts) [5] escalation: 1M (bf16 — f32 exceeds HBM at 1M per AOT_MEMORY)"
+MARLIN_BENCH_LCT_SEQ=1048576 MARLIN_BENCH_ATTN_SEQ=1048576 \
+  MARLIN_BENCH_LCT_DTYPE=bfloat16 python bench_all.py lct_long attn_long
+
+echo "$(ts) follow-up batch done"

@@ -11,7 +11,10 @@ runner logs but does not abort on it — the dense benches don't depend on
 these kernels).
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

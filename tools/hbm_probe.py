@@ -28,16 +28,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 GIB = 1024 ** 3
 
-# (label, seq, compute_dtype) — ascending predicted HBM per AOT_MEMORY.json
-# (256k f32 4.29 GiB, 512k f32 8.55, 1M bf16 10.07, 1M f32 14.57) so each
-# cumulative high-water is attributable to the config that just ran; the
-# thin-margin flagship claim (1M f32) runs LAST because it is the one most
-# likely to OOM against the runtime-reserved budget.
+# (label, seq, compute_dtype, mlp_chunk) — ascending predicted HBM per
+# AOT_MEMORY.json (256k f32 3.79 GiB, 512k f32 7.56, 1M bf16 8.08,
+# 1M f32 14.08, 2M bf16+mlp_chunk 14.18, 2M bf16 15.12) so each cumulative
+# high-water is attributable to the config that just ran; plain 2M bf16 runs
+# LAST because its 15.12 GiB prediction is the thinnest margin of any claim
+# and the most likely to OOM against the runtime-reserved budget.
 CONFIGS = [
-    ("lct_long_262144", 262144, None),
-    ("lct_long_524288", 524288, None),
-    ("lct_long_bf16_1048576", 1048576, "bfloat16"),
-    ("lct_long_1048576", 1048576, None),
+    ("lct_long_262144", 262144, None, None),
+    ("lct_long_524288", 524288, None, None),
+    ("lct_long_bf16_1048576", 1048576, "bfloat16", None),
+    ("lct_long_1048576", 1048576, None, None),
+    # the round-5 packed-flash-state headline: 2M bf16 on one chip.
+    # mlp_chunk=16384 matches the knob value docs/parallelism.md tells
+    # users to set at 2M (the 14.18 GiB prediction is derived for it)
+    ("lct_long_bf16_mlpchunk_2097152", 2097152, "bfloat16", 16384),
+    ("lct_long_bf16_2097152", 2097152, "bfloat16", None),
 ]
 
 
@@ -74,13 +80,17 @@ def main():
     import marlin_tpu as mt  # noqa: F401
     from marlin_tpu.models.transformer import TransformerLM, lm_train_step
 
-    for label, seq, cd in CONFIGS:
+    for label, seq, cd, mlp_chunk in CONFIGS:
         sec = "lct_long_bf16" if cd else "lct_long"
-        pred = (aot.get(sec, {}).get(str(seq)) or {}).get("peak_bytes")
+        # AOT_MEMORY.json has no mlp_chunk section; docs/parallelism.md
+        # carries that prediction — leave pred unset rather than mislabel
+        pred = (None if mlp_chunk else
+                (aot.get(sec, {}).get(str(seq)) or {}).get("peak_bytes"))
         lm = TransformerLM(vocab=512, d_model=256, heads=2, layers=2,
                           attn="ring_flash", remat=True, loss_chunk=16384,
                           compute_dtype=cd)
-        rec = {"seq": seq, "compute_dtype": cd, "aot_peak_bytes": pred}
+        rec = {"seq": seq, "compute_dtype": cd, "mlp_chunk": mlp_chunk,
+               "aot_peak_bytes": pred}
         try:
             pre_peak = int((dev.memory_stats() or {})
                            .get("peak_bytes_in_use", 0))
@@ -92,7 +102,7 @@ def main():
                 params, opt_state, tokens, jax.sharding.Mesh(
                     np.array(jax.devices()[:1]), ("rows",)),
                 lm.heads, lm.attn, lm.remat, lm.precision, lm.learning_rate,
-                lm.loss_chunk, lm.compute_dtype)
+                lm.loss_chunk, lm.compute_dtype, mlp_chunk)
             rec["loss"] = float(loss)  # forces completion (sync point)
             del params, opt_state, tokens, loss
             peak = int((dev.memory_stats() or {}).get("peak_bytes_in_use", 0))

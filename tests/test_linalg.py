@@ -115,6 +115,21 @@ def test_cholesky_schedules_agree(mesh):
     np.testing.assert_allclose(ls[0] @ ls[0].T, a, rtol=1e-3, atol=1e-2)
 
 
+def test_auto_schedule_is_op_aware():
+    # r5 on-chip shoot-out (BENCH_ALL, 8192²): shrinking wins for LU,
+    # masked wins for Cholesky — "auto" must resolve per op
+    from marlin_tpu.linalg.factorizations import _resolve_schedule
+
+    assert _resolve_schedule("auto", 16) == "shrinking"
+    assert _resolve_schedule("auto", 100) == "masked"  # past unroll cap
+    assert _resolve_schedule("auto", 16, pivot="panel") == "masked"
+    assert _resolve_schedule("auto", 16, op="cholesky") == "masked"
+    assert _resolve_schedule("auto", 100, op="cholesky") == "masked"
+    # explicit choice always wins over the op-aware default
+    assert _resolve_schedule("shrinking", 100, op="cholesky") == "shrinking"
+    assert _resolve_schedule("masked", 16) == "masked"
+
+
 def test_inverse_schedules_agree(mesh):
     n = 16
     a = _well_conditioned(n, 6)

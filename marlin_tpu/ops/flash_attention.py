@@ -35,6 +35,29 @@ __all__ = ["flash_attention_panel", "flash_attention_panel_bwd",
 
 _NEG = -1e30
 
+# Every kernel dot pins an EXPLICIT precision: left to the backend default,
+# the f32 matmuls silently ran single-pass bf16 after a runtime update
+# changed Mosaic's default — rel err 3.03e-3 (2^-8 mantissa) against the
+# pinned-precision oracle, caught by tools/tpu_smoke.py (rounds 2-4 rode the
+# OLD default, which extended f32 operands to true-f32 MXU passes — the
+# class every prior measurement of this kernel had). The pin is HIGHEST:
+# Mosaic lowers exactly DEFAULT and HIGHEST (HIGH/bf16_3x is rejected:
+# "Unsupported dot precision"), and HIGHEST reproduces the historical
+# numerics. The measured 2x single-pass speedup (13 ms vs 26 ms at 32k)
+# remains available through the EXISTING accuracy knob — precision="default"
+# casts Q/K/V to bf16, and bf16 operands are unaffected by the pin
+# (precision controls only the f32 decomposition). The backward casts its
+# f32 probability/ds tiles DOWN to the input dtype before each dot, so
+# bf16-mode backward matmuls stay single-pass like the forward's.
+_DOT_PREC = jax.lax.Precision.HIGHEST
+
+
+def _prec(ref_or_val):
+    """HIGHEST for f32 operands only: Mosaic rejects an explicit precision
+    on bf16 dots ("Bad lhs type" — there is no f32 decomposition to pick),
+    and bf16's native single-pass matmul is the wanted behavior anyway."""
+    return _DOT_PREC if ref_or_val.dtype == jnp.float32 else None
+
 
 def block_divisor(n: int, cap: int | None = None) -> int:
     """The flash block-size policy shared by every caller of
@@ -100,7 +123,7 @@ def _panel_kernel(s_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
     def _accumulate():
         s = jax.lax.dot_general(
             q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_prec(q_ref),
         ) * scale
         kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
         keep = kpos < valid
@@ -117,7 +140,8 @@ def _panel_kernel(s_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
                        jnp.exp(s3 - m_new[:, :, None]), 0.0)
         l_s[:] = l_s[:] * alpha + jnp.sum(p3, axis=2)
         pv = jnp.dot(p3.reshape(bq, bkv).astype(v_ref.dtype), v_ref[:],
-                     preferred_element_type=jnp.float32)
+                     preferred_element_type=jnp.float32,
+                     precision=_prec(v_ref))
         d = acc_s.shape[-1]
         acc3 = acc_s[:].reshape(g, 128, d)
         acc_s[:] = (acc3 * alpha[:, :, None]
@@ -148,7 +172,7 @@ def _bwd_p_ds(q_blk, k_blk, v_blk, do_blk, lse_blk, delta_blk,
     g = bq // 128
     s = jax.lax.dot_general(
         q_blk, k_blk, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=_prec(q_blk),
     ) * scale
     kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
     keep = kpos < valid
@@ -160,7 +184,7 @@ def _bwd_p_ds(q_blk, k_blk, v_blk, do_blk, lse_blk, delta_blk,
                   0.0)
     dp = jax.lax.dot_general(
         do_blk, v_blk, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=_prec(do_blk),
     )
     ds = p * (dp.reshape(g, 128, bkv)
               - delta_blk[:, :, None]).reshape(bq, bkv)
@@ -191,10 +215,12 @@ def _bwd_dkv_kernel(s_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                           causal=causal, scale=scale, bq=bq, bkv=bkv)
         # explicit-transpose dot: the canonical Mosaic-supported form for
         # contracting the sublane dim (jax pallas tpu flash kernels)
-        dv_s[:] += jax.lax.dot(p.T, do_ref[:].astype(jnp.float32),
-                               preferred_element_type=jnp.float32)
-        dk_s[:] += jax.lax.dot(ds.T, q_ref[:].astype(jnp.float32),
-                               preferred_element_type=jnp.float32) * scale
+        dv_s[:] += jax.lax.dot(p.T.astype(do_ref.dtype), do_ref[:],
+                               preferred_element_type=jnp.float32,
+                               precision=_prec(do_ref))
+        dk_s[:] += jax.lax.dot(ds.T.astype(q_ref.dtype), q_ref[:],
+                               preferred_element_type=jnp.float32,
+                               precision=_prec(q_ref)) * scale
 
     @pl.when(i == pl.num_programs(1) - 1)
     def _flush():
@@ -223,8 +249,8 @@ def _bwd_dq_kernel(s_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                           delta_ref[:], q_start, k_start, valid,
                           causal=causal, scale=scale, bq=bq, bkv=bkv)
         dq_s[:] += jnp.dot(
-            ds, k_ref[:].astype(jnp.float32),
-            preferred_element_type=jnp.float32,
+            ds.astype(k_ref.dtype), k_ref[:],
+            preferred_element_type=jnp.float32, precision=_prec(k_ref),
         ) * scale
 
     @pl.when(j == pl.num_programs(1) - 1)

@@ -333,14 +333,15 @@ def ring_attention(
     ``"xla"`` keeps the tiled XLA formulation; ``"auto"`` picks flash on TPU
     for MXU-friendly head dims and XLA elsewhere.
 
-    ``precision``: ``"high"`` computes the QKᵀ and PV matmuls on the operands'
-    own dtype (f32 in → f32 MXU passes); ``"default"`` casts Q/K/V to
-    bfloat16 for the matmuls — the standard production-attention contract
-    (softmax statistics and the output accumulator stay f32; only the MXU
-    operands narrow). Measured at d=128/seq=32k the two are within noise of
-    each other (the kernel is softmax/VPU-bound there, BENCHMARKS.md); the
-    bf16 MXU advantage materializes at larger head dims where the matmuls
-    dominate. Mirrors the ``precision`` knob of ``DenseVecMatrix.multiply``."""
+    ``precision``: ``"high"`` keeps Q/K/V in their own dtype and both
+    backends then pin true-f32 matmuls (the flash kernel via
+    ``ops.flash_attention._DOT_PREC`` — pinned because a runtime update
+    changed Mosaic's unpinned default to single-pass bf16, 3e-3 error
+    against the oracle). ``"default"`` casts Q/K/V to bfloat16 for the
+    matmuls — the standard production-attention contract, and the speed
+    path: the kernel is matmul-bound on chip (13 ms bf16 vs 26 ms f32 at
+    32k/d=128). Softmax statistics and the output accumulator stay f32 in
+    every mode. Mirrors ``DenseVecMatrix.multiply``'s ``precision`` knob."""
     if q.ndim < 2 or k.shape != q.shape or v.shape != q.shape:
         raise ValueError(f"q/k/v shape mismatch: {q.shape} {k.shape} {v.shape}")
     if q.ndim > 3:

@@ -110,8 +110,12 @@ def main() -> int:
     coo_v = rng.random(nb * bs * bs).astype(np.float32)
     bsr = bsr_from_coo(coo_r, coo_c, coo_v, (M, K), block_size=bs)
     b_dense = jnp.asarray(rng.standard_normal((K, N)).astype(np.float32))
-    yp = bsr.multiply(b_dense, backend="pallas")
-    yc = bsr.multiply(b_dense, backend="chunked")
+    # pin true-f32 matmuls for BOTH paths: this is a correctness gate, and
+    # the two formulations' bf16-default roundings differ by summation order
+    # (the production default stays whatever the caller's precision is)
+    with jax.default_matmul_precision("highest"):
+        yp = bsr.multiply(b_dense, backend="pallas")
+        yc = bsr.multiply(b_dense, backend="chunked")
     ebsr = float(jnp.max(jnp.abs(yp - yc)) /
                  jnp.maximum(jnp.max(jnp.abs(yc)), 1e-30))
     print(f"bsr pallas vs chunked rel err: {ebsr:.2e}")

@@ -12,6 +12,7 @@ from ..ml.als import ALSModel, als_run  # noqa: F401
 from ..ml.logistic_regression import LogisticRegressionModel, logistic_regression  # noqa: F401
 from ..ml.neural_network import NeuralNetwork, mlp_forward, mlp_init, train_step  # noqa: F401
 from ..ml.pagerank import build_transition_matrix, pagerank  # noqa: F401
+from .moe import init_moe, moe_ffn, shard_moe_params  # noqa: F401
 from .planner import ContextPlan, plan_context, usable_hbm_bytes  # noqa: F401
 from .transformer import (  # noqa: F401
     TransformerLM,

@@ -43,7 +43,8 @@ def synthetic_stream(seq: int, vocab: int = 64, seed: int = 0,
 
 def init_transformer(key, vocab: int, d_model: int, heads: int, layers: int,
                      d_ff: int | None = None, dtype=jnp.float32,
-                     kv_heads: int | None = None) -> dict:
+                     kv_heads: int | None = None, n_experts: int | None = None,
+                     moe_every: int = 1) -> dict:
     """Scaled-normal init; tied input/output embedding. ``kv_heads`` enables
     grouped-query attention: ``heads // kv_heads`` query heads share one K/V
     head (wk/wv project to ``kv_heads·dh``), which divides the decode KV
@@ -52,27 +53,43 @@ def init_transformer(key, vocab: int, d_model: int, heads: int, layers: int,
     head count inside the block, so the in-attention activations stay
     full-size there — the knob is a serving lever.) Every consumer derives
     the K/V head count from the parameter shapes, so GQA needs no signature
-    changes anywhere downstream."""
+    changes anywhere downstream.
+
+    ``n_experts`` switches the FFN of every ``moe_every``-th layer (counting
+    from layer ``moe_every - 1``; the default 1 = every layer) to a
+    mixture-of-experts with that many experts (:mod:`.moe` — router + per-
+    expert FFN params under the layer's ``"moe"`` key, in place of w1/w2).
+    Routing-time knobs (top_k / capacity / grouping) live in the forward's
+    ``moe`` argument, not in the params."""
     d_ff = d_ff or 4 * d_model
     kvh = heads if kv_heads is None else kv_heads
     if kvh < 1 or heads % kvh:
         raise ValueError(f"kv_heads ({kvh}) must divide heads ({heads})")
+    if moe_every < 1:
+        raise ValueError(f"moe_every must be >= 1, got {moe_every}")
     kv_dim = (d_model // heads) * kvh
     ks = jax.random.split(key, 2 + 6 * layers)
     p = {"emb": jax.random.normal(ks[0], (vocab, d_model), dtype) * 0.02}
     for i in range(layers):
         k = ks[2 + 6 * i: 8 + 6 * i]
         s = 1.0 / math.sqrt(d_model)
-        p[f"l{i}"] = {
+        lp = {
             "wq": jax.random.normal(k[0], (d_model, d_model), dtype) * s,
             "wk": jax.random.normal(k[1], (d_model, kv_dim), dtype) * s,
             "wv": jax.random.normal(k[2], (d_model, kv_dim), dtype) * s,
             "wo": jax.random.normal(k[3], (d_model, d_model), dtype) * s,
-            "w1": jax.random.normal(k[4], (d_model, d_ff), dtype) * s,
-            "w2": jax.random.normal(k[5], (d_ff, d_model), dtype) / math.sqrt(d_ff),
             "ln1": jnp.ones((d_model,), dtype),
             "ln2": jnp.ones((d_model,), dtype),
         }
+        if n_experts is not None and (i + 1) % moe_every == 0:
+            from .moe import init_moe
+
+            lp["moe"] = init_moe(k[4], d_model, d_ff, n_experts, dtype)
+        else:
+            lp["w1"] = jax.random.normal(k[4], (d_model, d_ff), dtype) * s
+            lp["w2"] = (jax.random.normal(k[5], (d_ff, d_model), dtype)
+                        / math.sqrt(d_ff))
+        p[f"l{i}"] = lp
     p["ln_f"] = jnp.ones((d_model,), dtype)
     return p
 
@@ -86,6 +103,10 @@ def _rmsnorm(x, g):
 
 
 _ATTN_BACKENDS = {"ring": "auto", "ring_flash": "flash", "ring_xla": "xla"}
+
+# (top_k, capacity_factor, group_size) when a model has MoE layers but the
+# caller didn't pass routing knobs — one place, shared by train + prefill
+_MOE_DEFAULTS = (2, 1.25, 4096)
 
 
 def _mlp(h, w1, w2, chunk: int | None):
@@ -115,7 +136,7 @@ def _mlp(h, w1, w2, chunk: int | None):
 
 
 def _block(lp, x, heads: int, mesh, attn: str, precision: str,
-           mlp_chunk: int | None = None):
+           mlp_chunk: int | None = None, moe: tuple | None = None):
     # No explicit sequence-sharding constraints here: XLA's sharding
     # propagation from the ring's internal placements already shards the
     # residual stream and projections over the mesh rows axis (verified by
@@ -150,7 +171,16 @@ def _block(lp, x, heads: int, mesh, attn: str, precision: str,
     o = o.transpose(1, 0, 2).reshape(seq, d).astype(cd) @ lp["wo"].astype(cd)
     x = x + o
     h = _rmsnorm(x, lp["ln2"])
-    return x + _mlp(h, lp["w1"].astype(cd), lp["w2"].astype(cd), mlp_chunk)
+    if "moe" in lp:
+        from .moe import moe_ffn
+
+        tk, cf, gs = moe if moe is not None else _MOE_DEFAULTS
+        out, aux = moe_ffn(lp["moe"], h, mesh=mesh, top_k=tk,
+                           capacity_factor=cf, group_size=gs,
+                           precision=precision)
+        return x + out, aux
+    return (x + _mlp(h, lp["w1"].astype(cd), lp["w2"].astype(cd), mlp_chunk),
+            jnp.zeros((), jnp.float32))
 
 
 def transformer_forward(params: dict, tokens, mesh=None, heads: int = 4,
@@ -158,7 +188,8 @@ def transformer_forward(params: dict, tokens, mesh=None, heads: int = 4,
                         precision: str = "high",
                         compute_dtype: str | None = None,
                         mlp_chunk: int | None = None,
-                        offload_residuals: bool = False):
+                        offload_residuals: bool = False,
+                        moe: tuple | None = None):
     """Logits for next-token prediction; ``tokens`` is a (seq,) int array.
     ``attn``: "ring" (sequence rotates K/V panels; backend auto-picked),
     "ring_flash" / "ring_xla" (ring with the backend pinned), or "ulysses"
@@ -168,9 +199,12 @@ def transformer_forward(params: dict, tokens, mesh=None, heads: int = 4,
     through that dtype while params/optimizer stay f32 — the other half of
     the long-context HBM budget (activations dominate it; see
     docs/parallelism.md) and the bf16-MXU speed path. ``offload_residuals``
-    parks the remat checkpoints in host RAM (:func:`_trunk`)."""
-    x = _trunk(params, tokens, mesh, heads, attn, remat, precision,
-               compute_dtype, mlp_chunk, offload_residuals)
+    parks the remat checkpoints in host RAM (:func:`_trunk`). ``moe``:
+    (top_k, capacity_factor, group_size) routing knobs for MoE layers
+    (models with ``n_experts``; ignored otherwise — the load-balance aux
+    term is a training concern, see :func:`lm_loss`)."""
+    x, _ = _trunk(params, tokens, mesh, heads, attn, remat, precision,
+                  compute_dtype, mlp_chunk, offload_residuals, moe)
     return _head_logits(x, params["emb"])
 
 
@@ -183,7 +217,8 @@ def _head_logits(x, emb):
 
 
 def _trunk(params, tokens, mesh, heads, attn, remat, precision,
-           compute_dtype=None, mlp_chunk=None, offload_residuals=False):
+           compute_dtype=None, mlp_chunk=None, offload_residuals=False,
+           moe=None):
     """Final-rmsnorm hidden states, (seq, d_model) — the forward minus the
     LM head projection. With ``compute_dtype``, the residual stream and every
     matmul operand are cast to it (norm statistics and softmax stay f32
@@ -211,7 +246,8 @@ def _trunk(params, tokens, mesh, heads, attn, remat, precision,
         x = x.astype(compute_dtype)
     n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
     blk = functools.partial(_block, heads=heads, mesh=mesh, attn=attn,
-                            precision=precision, mlp_chunk=mlp_chunk)
+                            precision=precision, mlp_chunk=mlp_chunk, moe=moe)
+    aux = jnp.zeros((), jnp.float32)
     if remat and offload_residuals:
         # scan over STACKED layers: in a Python loop the inter-block
         # residuals are plain SSA values XLA keeps on device regardless of
@@ -221,19 +257,27 @@ def _trunk(params, tokens, mesh, heads, attn, remat, precision,
         # iteration
         from jax.ad_checkpoint import checkpoint_name
 
-        stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
-                               *[params[f"l{i}"] for i in range(n_layers)])
+        trees = [params[f"l{i}"] for i in range(n_layers)]
+        if any(set(t) != set(trees[0]) for t in trees[1:]):
+            raise ValueError(
+                "offload_residuals stacks the layers into one scan, which "
+                "needs uniform layer structure — moe_every > 1 mixes MoE "
+                "and dense FFN layers; use moe_every=1 or drop the offload")
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
 
         def body(h, lp):
-            return blk(lp, checkpoint_name(h, "marlin_resid")), None
+            h2, a = blk(lp, checkpoint_name(h, "marlin_resid"))
+            return h2, a
 
         body = jax.checkpoint(body, policy=_OFFLOAD_POLICY())
-        x, _ = jax.lax.scan(body, x, stacked)
+        x, auxs = jax.lax.scan(body, x, stacked)
+        aux = jnp.sum(auxs)
     else:
         for i in range(n_layers):
             b = jax.checkpoint(blk) if remat else blk
-            x = b(params[f"l{i}"], x)
-    return _rmsnorm(x, params["ln_f"])
+            x, a = b(params[f"l{i}"], x)
+            aux = aux + a
+    return _rmsnorm(x, params["ln_f"]), aux
 
 
 def _OFFLOAD_POLICY():
@@ -272,36 +316,40 @@ def _chunked_nll(x, emb, targets, chunk: int):
 def lm_loss(params, tokens, mesh=None, heads: int = 4, attn: str = "ring",
             remat: bool = False, precision: str = "high",
             loss_chunk: int | None = None, compute_dtype: str | None = None,
-            mlp_chunk: int | None = None, offload_residuals: bool = False):
+            mlp_chunk: int | None = None, offload_residuals: bool = False,
+            moe: tuple | None = None, moe_aux_weight: float = 1e-2):
     """Mean next-token cross-entropy over the sequence. ``loss_chunk`` scans
     the LM head over that many tokens at a time (see :func:`_chunked_nll`) —
     the long-context memory knob companion to ``remat``. ``compute_dtype``
     runs activations in that dtype (loss math itself stays f32);
     ``offload_residuals`` parks the remat checkpoints in host RAM
-    (see :func:`_trunk`)."""
+    (see :func:`_trunk`). For MoE models, ``moe_aux_weight`` times the
+    summed Switch load-balance term joins the loss (``moe`` carries the
+    routing knobs); dense models contribute an exact zero there."""
     tgt = jnp.asarray(tokens[1:])
-    if loss_chunk is None:
-        logits = transformer_forward(params, tokens[:-1], mesh, heads, attn,
-                                     remat, precision, compute_dtype,
-                                     mlp_chunk, offload_residuals)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        return -jnp.mean(jnp.take_along_axis(logp, tgt[:, None], axis=1))
-    if loss_chunk < 1:
+    if loss_chunk is not None and loss_chunk < 1:
         raise ValueError(f"loss_chunk must be >= 1 or None, got {loss_chunk}")
-    x = _trunk(params, tokens[:-1], mesh, heads, attn, remat, precision,
-               compute_dtype, mlp_chunk, offload_residuals)
-    return _chunked_nll(x, params["emb"], tgt, loss_chunk) / tgt.shape[0]
+    x, aux = _trunk(params, tokens[:-1], mesh, heads, attn, remat, precision,
+                    compute_dtype, mlp_chunk, offload_residuals, moe)
+    if loss_chunk is None:
+        logp = jax.nn.log_softmax(_head_logits(x, params["emb"]), axis=-1)
+        nll = -jnp.mean(jnp.take_along_axis(logp, tgt[:, None], axis=1))
+    else:
+        nll = _chunked_nll(x, params["emb"], tgt, loss_chunk) / tgt.shape[0]
+    return nll + moe_aux_weight * aux
 
 
 @functools.partial(jax.jit, static_argnames=(
     "mesh", "heads", "attn", "remat", "precision", "lr", "loss_chunk",
-    "compute_dtype", "mlp_chunk", "offload_residuals"))
+    "compute_dtype", "mlp_chunk", "offload_residuals", "moe"))
 def lm_train_step(params, opt_state, tokens, mesh, heads: int, attn: str,
                   remat: bool, precision: str, lr: float,
                   loss_chunk: int | None = None,
                   compute_dtype: str | None = None,
                   mlp_chunk: int | None = None,
-                  offload_residuals: bool = False):
+                  offload_residuals: bool = False,
+                  moe: tuple | None = None,
+                  moe_aux_weight=1e-2):
     """One Adam step, jitted at module level with static config primitives so
     repeated ``train()`` calls (and the bench's warm-up-then-time discipline)
     hit one compiled program — the same cache pattern as
@@ -311,7 +359,7 @@ def lm_train_step(params, opt_state, tokens, mesh, heads: int, attn: str,
     loss, grads = jax.value_and_grad(
         lambda p: lm_loss(p, tokens, mesh, heads, attn, remat, precision,
                           loss_chunk, compute_dtype, mlp_chunk,
-                          offload_residuals)
+                          offload_residuals, moe, moe_aux_weight)
     )(params)
     updates, opt_state = optax.adam(lr).update(grads, opt_state, params)
     return optax.apply_updates(params, updates), opt_state, loss
@@ -359,7 +407,8 @@ def _pick_tokens(temperature, top_p, top_k, logits, sub):
         lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32))
 
 
-def _decode_step(params, x, caches, pos, heads: int):
+def _decode_step(params, x, caches, pos, heads: int,
+                 moe: tuple | None = None):
     """One cached decode position: ``x`` is the (d_model,) embedded token at
     ``pos`` in the compute dtype (the caches and residual stream follow it);
     ``caches`` maps layer -> (k, v) of shape (max_len, kv_heads, dh) —
@@ -393,7 +442,15 @@ def _decode_step(params, x, caches, pos, heads: int):
             @ lp["wo"].astype(cd)
         x = x + o
         h = _rmsnorm(x, lp["ln2"])
-        x = x + jax.nn.gelu(h @ lp["w1"].astype(cd)) @ lp["w2"].astype(cd)
+        if "moe" in lp:
+            # single-token routing is exact (no capacity machinery): gather
+            # the chosen experts' weights and combine — see moe_decode_ffn
+            from .moe import moe_decode_ffn
+
+            x = x + moe_decode_ffn(
+                lp["moe"], h, top_k=(moe or _MOE_DEFAULTS)[0])
+        else:
+            x = x + jax.nn.gelu(h @ lp["w1"].astype(cd)) @ lp["w2"].astype(cd)
         new_caches[f"l{i}"] = (ck, cv)
     x = _rmsnorm(x, params["ln_f"])
     return _head_logits(x, params["emb"]), new_caches
@@ -445,7 +502,8 @@ def _prefill_attn(q, k, v, cdtype):
     return jnp.moveaxis(o[:, :P], 0, 1).astype(cdtype)
 
 
-def _prefill_hidden(params, prompt, heads: int, max_len: int, cdtype):
+def _prefill_hidden(params, prompt, heads: int, max_len: int, cdtype,
+                    moe: tuple | None = None):
     """Process the whole prompt in ONE parallel forward — every projection is
     a (P, d) @ (d, d) MXU matmul and the causal attention is batched (dense
     for short prompts, the flash kernel past :data:`_PREFILL_FLASH_MIN` — see
@@ -478,19 +536,32 @@ def _prefill_hidden(params, prompt, heads: int, max_len: int, cdtype):
         o = _prefill_attn(q, k, v, cdtype)
         x = x + o.reshape(P, d) @ lp["wo"].astype(cdtype)
         h = _rmsnorm(x, lp["ln2"])
-        x = x + jax.nn.gelu(h @ lp["w1"].astype(cdtype)) @ lp["w2"].astype(cdtype)
+        if "moe" in lp:
+            # same grouped routing as training (so prefill states match the
+            # training forward); single-device at decode, so no mesh
+            from .moe import moe_ffn
+
+            tk, cf, gs = moe if moe is not None else _MOE_DEFAULTS
+            mo, _ = moe_ffn(lp["moe"], h, mesh=None, top_k=tk,
+                            capacity_factor=cf, group_size=gs)
+            x = x + mo
+        else:
+            x = x + (jax.nn.gelu(h @ lp["w1"].astype(cdtype))
+                     @ lp["w2"].astype(cdtype))
     return _rmsnorm(x, params["ln_f"]), caches
 
 
-def _prefill(params, prompt, heads: int, max_len: int, cdtype):
+def _prefill(params, prompt, heads: int, max_len: int, cdtype,
+             moe: tuple | None = None):
     """Final-position logits + caches (the single-sequence prefill form)."""
-    x, caches = _prefill_hidden(params, prompt, heads, max_len, cdtype)
+    x, caches = _prefill_hidden(params, prompt, heads, max_len, cdtype, moe)
     return _head_logits(x[-1], params["emb"]), caches
 
 
 def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
                 temperature=0.0, compute_dtype: str | None = None,
-                top_p=None, top_k: int | None = None):
+                top_p=None, top_k: int | None = None,
+                moe: tuple | None = None):
     """KV-cached autoregressive decode: batched prefill of the prompt (one
     parallel forward, :func:`_prefill`), then one ``lax.scan`` sampling
     ``steps`` tokens — the whole generation is a single XLA program.
@@ -512,15 +583,16 @@ def lm_generate(params, prompt, key, heads: int, max_len: int, steps: int,
         temperature=jnp.asarray(temperature, jnp.float32),
         compute_dtype=compute_dtype,
         top_p=jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
-        use_top_p=top_p is not None, top_k=top_k)
+        use_top_p=top_p is not None, top_k=top_k, moe=moe)
 
 
 @functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps",
                                              "compute_dtype", "use_top_p",
-                                             "top_k"))
+                                             "top_k", "moe"))
 def _lm_generate_jit(params, prompt, key, heads: int, max_len: int,
                      steps: int, temperature, compute_dtype,
-                     top_p, use_top_p: bool, top_k: int | None):
+                     top_p, use_top_p: bool, top_k: int | None,
+                     moe: tuple | None = None):
     n_prompt = prompt.shape[0]
     if n_prompt + steps > max_len:
         raise ValueError(
@@ -530,7 +602,7 @@ def _lm_generate_jit(params, prompt, key, heads: int, max_len: int,
     pick = functools.partial(_pick_tokens, temperature,
                              top_p if use_top_p else None, top_k)
     cdtype = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
-    logits0, caches = _prefill(params, prompt, heads, max_len, cdtype)
+    logits0, caches = _prefill(params, prompt, heads, max_len, cdtype, moe)
     key, sub = jax.random.split(key)
     first = pick(logits0, sub)
     tokens0 = (jnp.zeros((max_len,), jnp.int32)
@@ -539,7 +611,7 @@ def _lm_generate_jit(params, prompt, key, heads: int, max_len: int,
     def step(carry, pos):
         tokens, caches, key = carry
         x = params["emb"][tokens[pos]].astype(cdtype)
-        logits, caches = _decode_step(params, x, caches, pos, heads)
+        logits, caches = _decode_step(params, x, caches, pos, heads, moe)
         key, sub = jax.random.split(key)
         nxt = pick(logits, sub)
         tokens = tokens.at[pos + 1].set(nxt)  # pos+1 <= max_len-1
@@ -554,7 +626,8 @@ def _lm_generate_jit(params, prompt, key, heads: int, max_len: int,
 def lm_generate_batch(params, prompts, lengths, key, heads: int,
                       max_len: int, steps: int, temperature=0.0,
                       compute_dtype: str | None = None,
-                      top_p=None, top_k: int | None = None):
+                      top_p=None, top_k: int | None = None,
+                      moe: tuple | None = None):
     """Batched KV-cached decode: ``prompts`` is (B, P) int32 (rows padded to
     a common P), ``lengths`` (B,) the true prompt lengths — ragged batches
     decode together, each row continuing from ITS OWN position. Returns
@@ -577,16 +650,16 @@ def lm_generate_batch(params, prompts, lengths, key, heads: int,
         steps=steps, temperature=jnp.asarray(temperature, jnp.float32),
         compute_dtype=compute_dtype,
         top_p=jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
-        use_top_p=top_p is not None, top_k=top_k)
+        use_top_p=top_p is not None, top_k=top_k, moe=moe)
 
 
 @functools.partial(jax.jit, static_argnames=("heads", "max_len", "steps",
                                              "compute_dtype", "use_top_p",
-                                             "top_k"))
+                                             "top_k", "moe"))
 def _lm_generate_batch_jit(params, prompts, lengths, key, heads: int,
                            max_len: int, steps: int, temperature,
                            compute_dtype, top_p, use_top_p: bool,
-                           top_k: int | None):
+                           top_k: int | None, moe: tuple | None = None):
     B, P = prompts.shape
     if P + steps > max_len:
         raise ValueError(
@@ -598,7 +671,8 @@ def _lm_generate_batch_jit(params, prompts, lengths, key, heads: int,
     cdtype = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
 
     xs, caches = jax.vmap(
-        lambda p: _prefill_hidden(params, p, heads, max_len, cdtype))(prompts)
+        lambda p: _prefill_hidden(params, p, heads, max_len, cdtype,
+                                  moe))(prompts)
     hlast = jnp.take_along_axis(
         xs, (lengths - 1)[:, None, None], axis=1)[:, 0]  # (B, d)
     logits0 = _head_logits(hlast, params["emb"])
@@ -609,7 +683,7 @@ def _lm_generate_batch_jit(params, prompts, lengths, key, heads: int,
                .at[:, :P].set(prompts).at[rows, lengths].set(first))
 
     decode = jax.vmap(
-        lambda x, c, pos: _decode_step(params, x, c, pos, heads))
+        lambda x, c, pos: _decode_step(params, x, c, pos, heads, moe))
 
     def step(carry, t):
         tokens, caches, key = carry
@@ -672,11 +746,28 @@ class TransformerLM:
     # group factor — the serving memory lever. None = standard MHA. Every
     # downstream consumer derives it from the parameter shapes.
     kv_heads: int | None = None
+    # mixture-of-experts FFN (models/moe.py): n_experts switches every
+    # moe_every-th layer's FFN to that many experts, sharded over the mesh
+    # rows axis at training (expert parallelism — the all_to_all token
+    # shuffle comes from sharding constraints). top_k/capacity/group are the
+    # GShard routing knobs; aux_weight scales the Switch load-balance term.
+    n_experts: int | None = None
+    moe_every: int = 1
+    moe_top_k: int = _MOE_DEFAULTS[0]
+    moe_capacity_factor: float = _MOE_DEFAULTS[1]
+    moe_group: int = _MOE_DEFAULTS[2]
+    moe_aux_weight: float = 1e-2
+
+    def _moe(self) -> tuple | None:
+        if self.n_experts is None:
+            return None
+        return (self.moe_top_k, self.moe_capacity_factor, self.moe_group)
 
     def init_params(self, dtype=jnp.float32) -> dict:
         return init_transformer(jax.random.key(self.seed), self.vocab,
                                 self.d_model, self.heads, self.layers,
-                                self.d_ff, dtype, self.kv_heads)
+                                self.d_ff, dtype, self.kv_heads,
+                                self.n_experts, self.moe_every)
 
     def train(self, tokens, steps: int = 20, mesh=None, params=None,
               checkpoint_dir: str | None = None, checkpoint_every: int = 0,
@@ -691,6 +782,12 @@ class TransformerLM:
         mesh = mesh or default_mesh()
         tokens = jnp.asarray(np.asarray(tokens), jnp.int32)
         params = params if params is not None else self.init_params()
+        if self.n_experts is not None:
+            # expert parallelism by placement: shard the expert tensors over
+            # the mesh rows axis; propagation shards the expert compute
+            from .moe import shard_moe_params
+
+            params = shard_moe_params(params, mesh)
         opt_state = optax.adam(self.learning_rate).init(params)
 
         losses = []
@@ -699,7 +796,7 @@ class TransformerLM:
                 params, opt_state, tokens, mesh, self.heads, self.attn,
                 self.remat, self.precision, self.learning_rate,
                 self.loss_chunk, self.compute_dtype, self.mlp_chunk,
-                self.offload_residuals,
+                self.offload_residuals, self._moe(), self.moe_aux_weight,
             )
             losses.append(float(loss))
             if log_every and (it + 1) % log_every == 0:
@@ -723,7 +820,7 @@ class TransformerLM:
         return lm_generate(params, prompt, key, heads=self.heads,
                            max_len=max_len, steps=steps,
                            temperature=temperature, top_p=top_p, top_k=top_k,
-                           compute_dtype=self.compute_dtype)
+                           compute_dtype=self.compute_dtype, moe=self._moe())
 
     def generate_batch(self, params, prompts, steps: int = 32,
                        max_len: int | None = None, temperature=0.0,
@@ -744,6 +841,7 @@ class TransformerLM:
                                 heads=self.heads, max_len=max_len,
                                 steps=steps, temperature=temperature,
                                 top_p=top_p, top_k=top_k,
-                                compute_dtype=self.compute_dtype)
+                                compute_dtype=self.compute_dtype,
+                                moe=self._moe())
         out = np.asarray(out)
         return [out[i, : lengths[i] + steps] for i in range(len(prompts))]

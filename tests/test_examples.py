@@ -139,6 +139,16 @@ def test_long_context_training_cli(capsys):
     assert "greedy continuation" in out
 
 
+def test_moe_training_cli(capsys):
+    from examples.moe_training import main
+
+    losses = main(["192", "8", "4", "2", "32", "1"])
+    out = capsys.readouterr().out
+    assert "load-balance aux" in out and "tok/s" in out
+    assert losses[-1] < losses[0]
+    assert "greedy continuation" in out
+
+
 def test_long_context_training_cli_chunked(capsys):
     from examples.long_context_training import main
 

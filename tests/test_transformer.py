@@ -226,7 +226,7 @@ def test_compute_dtype_bf16_trains(mesh):
     import jax.numpy as jnp
     from marlin_tpu.models.transformer import _trunk
     p = amp.init_params()
-    x = _trunk(p, toks[:64], mesh, 4, "ring", False, "high", "bfloat16")
+    x, _ = _trunk(p, toks[:64], mesh, 4, "ring", False, "high", "bfloat16")
     assert x.dtype == jnp.bfloat16
 
 

@@ -138,7 +138,11 @@ def pipeline_apply(stage_params, stage_fn, x, mesh: Mesh | None = None,
             recv = jax.lax.ppermute(y, axis, fwd) if fwd else y
             return (recv, out_buf), None
 
-        init = (jnp.zeros_like(xm[0]), jnp.zeros_like(xm))
+        # zeros built from the LOCAL view's shape/dtype — zeros_like of the
+        # outer (sharded) xm would smuggle an Auto-mesh sharding into this
+        # Manual context, which the TPU lowering rejects
+        init = (jnp.zeros(xin.shape[1:], xin.dtype),
+                jnp.zeros(xin.shape, xin.dtype))
         # the tick output is device-varying (axis_index / ppermute); the
         # zero init must carry the same varying-manual-axes type
         init = jax.tree.map(

@@ -358,8 +358,15 @@ def ring_attention(
     seq, d = q.shape[-2], q.shape[-1]
     mesh = mesh or default_mesh()
     p_size = mesh.shape[axis]
+    # "auto" resolves from the MESH's device platform, not
+    # jax.default_backend(): the mesh is what the program actually runs (or
+    # AOT-compiles) on, and default_backend() would *initialize the runtime
+    # backend* at trace time — under a compile-only TPU topology with the
+    # device relay down, that blocked forever inside an otherwise
+    # chip-free AOT trace
     flash = backend == "flash" or (
-        backend == "auto" and jax.default_backend() == "tpu" and d % 128 == 0
+        backend == "auto" and d % 128 == 0
+        and next(iter(mesh.devices.flat)).platform == "tpu"
     )
     sp = pad_to_multiple(seq, p_size)
     if sp // p_size > _KV_TILE:

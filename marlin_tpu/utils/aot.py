@@ -100,7 +100,8 @@ def trace_lm_train_step(model, seq: int, mesh):
     return lm_train_step.trace(
         sds(params), sds(opt_state), tokens, mesh, model.heads, model.attn,
         model.remat, model.precision, model.learning_rate, model.loss_chunk,
-        model.compute_dtype, model.mlp_chunk, model.offload_residuals)
+        model.compute_dtype, model.mlp_chunk, model.offload_residuals,
+        model._moe(), model.moe_aux_weight)
 
 
 def parse_hbm_oom(exc) -> int | None:

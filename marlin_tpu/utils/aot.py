@@ -85,14 +85,29 @@ def trace_lm_train_step(model, seq: int, mesh):
     import optax
     from jax.sharding import NamedSharding, PartitionSpec
 
+    from ..mesh import ROWS
     from ..models.transformer import lm_train_step
 
     rep = NamedSharding(mesh, PartitionSpec())
+    # expert tensors carry the RUNTIME placement (shard_moe_params shards
+    # their leading expert axis over rows) — replicating them here would
+    # overstate per-chip expert + Adam memory by the axis size, making the
+    # planner's multi-chip MoE evidence diverge from the program that runs
+    exp = NamedSharding(mesh, PartitionSpec(ROWS, None, None))
+    rows = mesh.shape.get(ROWS, 1)
+
+    def leaf_sharding(path, x):
+        in_moe = any(getattr(k, "key", None) == "moe" for k in path)
+        if (in_moe and jnp.ndim(x) == 3
+                and jnp.shape(x)[0] % max(rows, 1) == 0):
+            return exp
+        return rep
 
     def sds(tree):
-        return jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
-                                           sharding=rep), tree)
+        return jax.tree_util.tree_map_with_path(
+            lambda p, x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
+                                              sharding=leaf_sharding(p, x)),
+            tree)
 
     params = jax.eval_shape(model.init_params)
     opt_state = jax.eval_shape(optax.adam(model.learning_rate).init, params)

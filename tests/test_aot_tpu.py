@@ -360,13 +360,10 @@ def test_moe_train_step_compiles_for_v5e():
     """The MoE LM train step (grouped GShard routing + Switch aux in the
     loss) through the REAL TPU compiler, single chip — top_k/cumsum/one_hot
     dispatch einsums and the scan-over-groups must all lower."""
-    from jax.sharding import Mesh
-
     from marlin_tpu.models import TransformerLM
     from marlin_tpu.utils.aot import trace_lm_train_step
 
-    topo = tpu_topology()
-    mesh = Mesh(np.array([topo.devices[0]]).reshape(1, 1), ("rows", "cols"))
+    mesh = topology_mesh(("rows", "cols"), (1, 1))
     lm = TransformerLM(vocab=512, d_model=256, heads=2, layers=2, remat=True,
                        loss_chunk=2048, n_experts=8, moe_group=2048)
     with mt.config_context(pallas_interpret=False):
@@ -379,13 +376,9 @@ def test_moe_expert_parallel_compiles_for_4chip_v5e():
     """Expert parallelism for a real 4-chip v5e: expert params sharded over
     the rows axis (the placement idiom), the compiler must accept and
     schedule the token-shuffle collectives its propagation inserts."""
-    from jax.sharding import Mesh
-
     from marlin_tpu.models.moe import init_moe, moe_ffn
 
-    topo = tpu_topology()
-    devs = list(np.asarray(topo.devices).ravel())
-    mesh = Mesh(np.array(devs).reshape(4, 1), ("rows", "cols"))
+    mesh = topology_mesh(("rows", "cols"), (4, 1))
     mp = jax.eval_shape(lambda: init_moe(jax.random.key(0), 256, 1024, 8))
     exp = NamedSharding(mesh, P("rows", None, None))
     rep = NamedSharding(mesh, P())
@@ -408,13 +401,9 @@ def test_moe_expert_parallel_compiles_for_4chip_v5e():
 def test_pipeline_compiles_for_4chip_v5e():
     """The GPipe schedule (shard_map + ppermute hops + masked psum collect)
     through the TPU compiler for a real 4-chip topology."""
-    from jax.sharding import Mesh
-
     from marlin_tpu.parallel.pipeline import pipeline_apply
 
-    topo = tpu_topology()
-    devs = list(np.asarray(topo.devices).ravel())
-    mesh = Mesh(np.array(devs).reshape(4, 1), ("rows", "cols"))
+    mesh = topology_mesh(("rows", "cols"), (4, 1))
     stage = NamedSharding(mesh, P("rows", None, None))
     params = {"w": jax.ShapeDtypeStruct((4, 512, 512), jnp.float32,
                                         sharding=stage)}

@@ -390,6 +390,38 @@ def config_lct(seq=32768, d_model=256, heads=2, layers=2, steps=3,
            f"fwd+bwd through flash ring attention{knobs}")
 
 
+def config_moe(seq=32768, d_model=256, heads=2, layers=2, n_experts=8,
+               steps=3):
+    """Mixture-of-experts LM training throughput at the lct shape: same
+    32k-token stream and flash ring attention, the FFN replaced by 8 experts
+    with GShard top-2 capacity routing (grouped — routing memory linear in
+    seq) and the Switch aux in the loss. The comparison row for
+    lct_32768tok: what expert routing costs at equal d_model (the MoE win is
+    CAPACITY — 8x FFN params at ~2x FFN FLOPs — not step time). No
+    reference analog (docs/parallelism.md "Expert parallelism")."""
+    import numpy as np
+
+    import marlin_tpu as mt
+    from marlin_tpu.models import TransformerLM
+
+    mesh = mt.create_mesh()
+    rng = np.random.default_rng(0)
+    vocab = 512
+    tokens = rng.integers(0, vocab, seq).astype(np.int32)
+    lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
+                       layers=layers, remat=True, loss_chunk=2048,
+                       n_experts=n_experts)
+    params, _ = lm.train(tokens, steps=1, mesh=mesh)  # compile
+    t0 = time.perf_counter()
+    params, losses = lm.train(tokens, steps=steps, mesh=mesh, params=params)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(losses[-1])
+    record(f"moe_{seq}tok_e{n_experts}_top2_d{d_model}_l{layers}",
+           seq * steps / dt / 1e3, "ktok/s",
+           f"{steps} steps in {dt:.1f} s, loss {losses[-1]:.3f}, "
+           f"{n_experts} experts/layer, grouped GShard routing + aux")
+
+
 def config_attn_long():
     """Pure-attention long-context point: one causal flash forward at 256k+
     tokens (MARLIN_BENCH_ATTN_SEQ scales; O(S²) compute so reps stay low)."""
@@ -671,6 +703,7 @@ def main():
         "lct_long": config_lct_long,
         "attn_long": config_attn_long,
         "decode": config_decode,
+        "moe": config_moe,
     }
     for k in which:
         log(f"=== config {k}")

@@ -76,4 +76,7 @@ MARLIN_BENCH_LCT_SEQ=1048576 MARLIN_BENCH_ATTN_SEQ=1048576 \
 echo "$(ts) [7] salvage of the legs the gen-2 hang ate: als pr svd"
 python bench_all.py als pr svd
 
+echo "$(ts) [8] new-family leg: MoE training throughput at the lct shape"
+python bench_all.py moe
+
 echo "$(ts) gen-3 batch done"

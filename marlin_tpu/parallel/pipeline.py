@@ -109,6 +109,12 @@ def pipeline_apply(stage_params, stage_fn, x, mesh: Mesh | None = None,
         jax.shard_map, mesh=mesh,
         in_specs=(pspecs, P(*(None,) * xm.ndim)),
         out_specs=P(*(None,) * xm.ndim),
+        # manualize ONLY the pipeline axis: every other mesh axis stays Auto
+        # inside, so a stage_fn can itself be tensor-parallel (weights
+        # sharded over e.g. "cols") with GSPMD inserting the activation
+        # collectives — pp composes with tp on one mesh instead of
+        # replicating non-pipeline-sharded params at this boundary
+        axis_names={axis},
     )
     def run(params, xin):
         # inside shard_map each leaf's stage axis is length 1: this device's

@@ -13,6 +13,7 @@ from ..ml.logistic_regression import LogisticRegressionModel, logistic_regressio
 from ..ml.neural_network import NeuralNetwork, mlp_forward, mlp_init, train_step  # noqa: F401
 from ..ml.pagerank import build_transition_matrix, pagerank  # noqa: F401
 from .moe import init_moe, moe_ffn, shard_moe_params  # noqa: F401
+from .pipeline_lm import pp_lm_loss, pp_lm_train_step, pp_stage_params  # noqa: F401
 from .planner import ContextPlan, plan_context, usable_hbm_bytes  # noqa: F401
 from .transformer import (  # noqa: F401
     TransformerLM,

@@ -1,0 +1,128 @@
+"""Pipeline-parallel causal-LM training: the transformer's layer stack as
+GPipe stages.
+
+:class:`~.transformer.TransformerLM` is the *context-parallel* trainer (ONE
+long sequence sharded around the ring — batch-of-one by design); this module
+is the complementary *batch* regime: many short sequences, the layer stack
+split into ``S = mesh.shape[axis]`` stage groups living on successive
+devices, microbatches of sequences streaming through
+(:func:`~marlin_tpu.parallel.pipeline.pipeline_apply`). Attention inside a
+stage is per-sequence causal self-attention (:func:`.transformer._prefill_attn`
+— dense for short sequences, the flash kernel past its threshold), so no
+collective runs inside a stage unless the caller additionally tensor-shards
+the stage weights over another mesh axis (pp x tp — pipeline_apply leaves
+non-pipeline axes Auto).
+
+Embedding, final norm, and the LM head run *outside* the pipeline: they are
+not width-uniform with the blocks, and their cost is a small fraction of the
+stack's. Params come from :func:`.transformer.init_transformer` (dense FFN;
+layer count divisible by the stage count).
+
+No reference analog: the reference's only DNN scales by data-parallel row
+partitioning (SURVEY.md §2.7); pipeline parallelism is one of the five
+canonical families the multi-chip mandate calls for (docs/parallelism.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..mesh import ROWS, default_mesh
+from ..parallel.pipeline import pipeline_apply, stack_stage_params
+from .transformer import _head_logits, _prefill_attn, _rmsnorm
+
+__all__ = ["pp_stage_params", "pp_lm_loss", "pp_lm_train_step"]
+
+
+def _pp_block(lp, x, heads: int):
+    """One transformer block over a (T, d) sequence with dense/flash causal
+    self-attention — the stage-local form of ``transformer._block`` (no
+    mesh, no ring: the sequence lives whole on the stage's device)."""
+    T, d = x.shape
+    cd = x.dtype
+    dh = d // heads
+    h = _rmsnorm(x, lp["ln1"])
+    q = (h @ lp["wq"].astype(cd)).reshape(T, heads, dh)
+    kvh = lp["wk"].shape[1] // dh
+    k = (h @ lp["wk"].astype(cd)).reshape(T, kvh, dh)
+    v = (h @ lp["wv"].astype(cd)).reshape(T, kvh, dh)
+    if kvh != heads:  # GQA broadcast, as in _block/_prefill_hidden
+        k, v = (jnp.repeat(t, heads // kvh, axis=1) for t in (k, v))
+    o = _prefill_attn(q, k, v, cd).reshape(T, d)
+    x = x + o @ lp["wo"].astype(cd)
+    h = _rmsnorm(x, lp["ln2"])
+    return x + jax.nn.gelu(h @ lp["w1"].astype(cd)) @ lp["w2"].astype(cd)
+
+
+def pp_stage_params(params, mesh=None, axis: str = ROWS):
+    """Re-shape ``init_transformer`` params into pipeline form: the L layer
+    trees stack into S stage groups of L/S layers (leaves gain leading
+    (S, L/S) axes, the stage axis sharded over ``axis`` — each stage's
+    layer group lives on its device). Returns ``(stage_params, outer)``
+    where ``outer`` holds the emb/ln_f leaves the pipeline does not touch."""
+    mesh = mesh or default_mesh()
+    n_stages = mesh.shape[axis]
+    n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
+    if n_layers % n_stages:
+        raise ValueError(
+            f"{n_layers} layers do not split into {n_stages} pipeline "
+            f"stages; choose layers divisible by the {axis!r} axis")
+    per = n_layers // n_stages
+    if any("moe" in params[f"l{i}"] for i in range(n_layers)):
+        raise ValueError(
+            "pipeline LM supports dense-FFN layers; run MoE models through "
+            "TransformerLM (expert parallelism) instead")
+    stages = []
+    for s in range(n_stages):
+        group = [params[f"l{s * per + j}"] for j in range(per)]
+        stages.append(jax.tree.map(lambda *xs: jnp.stack(xs), *group))
+    outer = {"emb": params["emb"], "ln_f": params["ln_f"]}
+    return stack_stage_params(stages, mesh, axis), outer
+
+
+def _stage_fn(heads, p_stage, x_mb):
+    """Apply this stage's L/S blocks to a (mb, T, d) microbatch."""
+
+    def one_layer(h, lp):
+        return jax.vmap(lambda row: _pp_block(lp, row, heads))(h), None
+
+    out, _ = jax.lax.scan(one_layer, x_mb, p_stage)
+    return out
+
+
+def pp_lm_loss(stage_params, outer, tokens, mesh=None, heads: int = 4,
+               axis: str = ROWS, microbatch: int | None = None):
+    """Mean next-token NLL over a (B, T) token batch with the layer stack
+    pipelined over ``axis``. Differentiable end-to-end (the backward
+    pipeline comes out of autodiff)."""
+    mesh = mesh or default_mesh()
+    tokens = jnp.asarray(tokens)
+    x = outer["emb"][tokens[:, :-1]]                  # (B, T-1, d)
+    x = pipeline_apply(stage_params, functools.partial(_stage_fn, heads), x,
+                       mesh, axis=axis, microbatch=microbatch)
+    x = _rmsnorm(x, outer["ln_f"])
+    logits = _head_logits(x, outer["emb"])
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tgt = tokens[:, 1:]
+    return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], axis=-1))
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "heads", "axis",
+                                             "microbatch", "lr"))
+def pp_lm_train_step(stage_params, outer, opt_state, tokens, mesh,
+                     heads: int = 4, axis: str = ROWS,
+                     microbatch: int | None = None, lr: float = 3e-3):
+    """One Adam step over (stage_params, outer) jointly — stage grads flow
+    back through the reversed pipeline, embedding/head grads directly."""
+    import optax
+
+    l, grads = jax.value_and_grad(
+        lambda t: pp_lm_loss(t[0], t[1], tokens, mesh, heads, axis,
+                             microbatch))((stage_params, outer))
+    updates, opt_state = optax.adam(lr).update(
+        grads, opt_state, (stage_params, outer))
+    stage_params, outer = optax.apply_updates((stage_params, outer), updates)
+    return stage_params, outer, opt_state, l

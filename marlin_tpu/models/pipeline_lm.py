@@ -32,7 +32,8 @@ import jax.numpy as jnp
 
 from ..mesh import ROWS, default_mesh
 from ..parallel.pipeline import pipeline_apply, stack_stage_params
-from .transformer import _head_logits, _prefill_attn, _rmsnorm
+from .transformer import (_head_logits, _n_layers, _prefill_attn,
+                          _rmsnorm)
 
 __all__ = ["pp_stage_params", "pp_lm_loss", "pp_lm_train_step"]
 
@@ -65,7 +66,7 @@ def pp_stage_params(params, mesh=None, axis: str = ROWS):
     where ``outer`` holds the emb/ln_f leaves the pipeline does not touch."""
     mesh = mesh or default_mesh()
     n_stages = mesh.shape[axis]
-    n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
+    n_layers = _n_layers(params)
     if n_layers % n_stages:
         raise ValueError(
             f"{n_layers} layers do not split into {n_stages} pipeline "

@@ -94,6 +94,13 @@ def init_transformer(key, vocab: int, d_model: int, heads: int, layers: int,
     return p
 
 
+def _n_layers(params: dict) -> int:
+    """Layer count from the params dict — THE accessor for the l{i} naming
+    scheme (transformer trunk, decode, prefill, and the pipeline trainer all
+    count through here)."""
+    return sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
+
+
 def _rmsnorm(x, g):
     """Statistics in f32 regardless of the activation dtype (bf16 squares
     underflow/overflow too readily); output back in the input's dtype."""
@@ -244,7 +251,7 @@ def _trunk(params, tokens, mesh, heads, attn, remat, precision,
     x = params["emb"][jnp.asarray(tokens)]
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
-    n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
+    n_layers = _n_layers(params)
     blk = functools.partial(_block, heads=heads, mesh=mesh, attn=attn,
                             precision=precision, mlp_chunk=mlp_chunk, moe=moe)
     aux = jnp.zeros((), jnp.float32)
@@ -418,7 +425,7 @@ def _decode_step(params, x, caches, pos, heads: int,
     group=1 case); the cache prefix is read via position masking (static
     shapes — the scan-friendly decode form of the causal mask);
     scores/softmax are f32."""
-    n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
+    n_layers = _n_layers(params)
     cd = x.dtype
     new_caches = {}
     for i in range(n_layers):
@@ -513,7 +520,7 @@ def _prefill_hidden(params, prompt, heads: int, max_len: int, cdtype,
     runs only for *generated* tokens (the previous formulation decoded the
     prompt position-by-position, P sequential cache updates that no batch
     dimension could amortize)."""
-    n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
+    n_layers = _n_layers(params)
     P = prompt.shape[0]
     d = params["emb"].shape[1]
     dh = d // heads

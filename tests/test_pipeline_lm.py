@@ -83,3 +83,40 @@ def test_pp_lm_gqa(mesh4):
     got = float(pp_lm_loss(sp, outer, toks, mesh4, heads=4, microbatch=1))
     want = float(_sequential_loss(p, toks, heads=4))
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_pp_lm_matches_flagship_lm_loss(mesh4):
+    # cross-MODEL parity: the pipelined stack must compute the same function
+    # as TransformerLM's lm_loss on the same params (pins _pp_block to the
+    # flagship _block math — a drift in either shows up here, unlike the
+    # sequential oracle built from _pp_block itself)
+    from marlin_tpu.models.transformer import lm_loss
+
+    p = init_transformer(jax.random.key(5), 32, 32, 2, 4)
+    toks = _token_batch(1, 65)
+    sp, outer = pp_stage_params(p, mesh4)
+    got = float(pp_lm_loss(sp, outer, toks, mesh4, heads=2, microbatch=1))
+    want = float(lm_loss(p, toks[0], mesh4, heads=2))
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+
+
+def test_pp_lm_grad_matches_sequential(mesh4):
+    # gradient parity through the reversed pipeline (incl. the masked-psum
+    # output collection), stage-by-stage against the sequential stack
+    p = init_transformer(jax.random.key(6), 32, 32, 2, 4)
+    toks = _token_batch(4, 17)
+    sp, outer = pp_stage_params(p, mesh4)
+    g_sp, g_outer = jax.grad(
+        lambda t: pp_lm_loss(t[0], t[1], toks, mesh4, heads=2, microbatch=1)
+    )((sp, outer))
+    g_seq = jax.grad(lambda pp: _sequential_loss(pp, toks, heads=2))(p)
+    for s in range(4):
+        np.testing.assert_allclose(np.asarray(g_sp["wq"][s, 0]),
+                                   np.asarray(g_seq[f"l{s}"]["wq"]),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g_sp["w2"][s, 0]),
+                                   np.asarray(g_seq[f"l{s}"]["w2"]),
+                                   rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_outer["emb"]),
+                               np.asarray(g_seq["emb"]),
+                               rtol=1e-4, atol=1e-6)

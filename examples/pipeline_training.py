@@ -1,0 +1,75 @@
+"""Pipeline-parallel LM training demo (no reference analog — the GPipe
+family applied to the flagship model, docs/parallelism.md "Pipeline
+parallelism"): the transformer's layer stack splits into one stage group per
+device of the mesh rows axis; microbatches of short sequences stream through
+the stages, activations hopping device-to-device over ICI; embedding and the
+LM head run outside the pipeline. The backward pipeline comes out of
+autodiff. Prints the loss trajectory and tokens/s.
+
+args: ``<batch> <seq len> <steps> [d_model] [layers] [microbatch]``
+(layers must divide by the mesh rows axis)
+"""
+
+import sys
+
+from examples._common import die, millis
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 3:
+        die("usage: pipeline_training <batch> <seq len> <steps> [d_model] "
+            "[layers] [microbatch]")
+    batch = int(argv[0])
+    seq = int(argv[1])
+    steps = int(argv[2])
+    d_model = int(argv[3]) if len(argv) > 3 else 128
+    layers = int(argv[4]) if len(argv) > 4 else None
+    microbatch = int(argv[5]) if len(argv) > 5 else None
+
+    import numpy as np
+    import optax
+
+    import marlin_tpu as mt
+    from marlin_tpu.models.pipeline_lm import (pp_lm_train_step,
+                                               pp_stage_params)
+    from marlin_tpu.models.transformer import (init_transformer,
+                                               synthetic_stream)
+
+    import jax
+
+    mesh = mt.create_mesh()
+    stages = mesh.shape["rows"]
+    if layers is None:
+        layers = stages  # one block per stage
+    heads = max(1, d_model // 64)
+    vocab = 512
+    toks = np.stack([synthetic_stream(seq, vocab=vocab, seed=i, period=16,
+                                      step=7) for i in range(batch)])
+
+    params = init_transformer(jax.random.key(0), vocab, d_model, heads,
+                              layers)
+    sp, outer = pp_stage_params(params, mesh)
+    opt_state = optax.adam(3e-3).init((sp, outer))
+    print(f"pipeline: {stages} stages x {layers // stages} blocks, "
+          f"batch {batch} x {seq} tokens")
+
+    losses = []
+    t0 = None
+    for it in range(steps):
+        sp, outer, opt_state, l = pp_lm_train_step(
+            sp, outer, opt_state, toks, mesh, heads=heads,
+            microbatch=microbatch, lr=3e-3)
+        losses.append(float(l))  # sync point
+        if it == 0:
+            t0 = millis()  # time past the compile
+    dt = (millis() - t0) / 1000.0 if steps > 1 else 0.0
+    print(f"loss: {losses[0]:.4f} -> {losses[-1]:.4f} over {steps} steps")
+    if steps > 1:
+        print(f"throughput: {batch * seq * (steps - 1) / dt:,.0f} tok/s "
+              f"({dt:.1f} s after compile)")
+    return losses
+
+
+if __name__ == "__main__":
+    main()

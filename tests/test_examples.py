@@ -139,6 +139,15 @@ def test_long_context_training_cli(capsys):
     assert "greedy continuation" in out
 
 
+def test_pipeline_training_cli(capsys):
+    from examples.pipeline_training import main
+
+    losses = main(["8", "65", "6", "32", "4", "2"])
+    out = capsys.readouterr().out
+    assert "stages" in out and "tok/s" in out
+    assert losses[-1] < losses[0]
+
+
 def test_moe_training_cli(capsys):
     from examples.moe_training import main
 

@@ -13,8 +13,9 @@ import pytest
 import marlin_tpu as mt
 from marlin_tpu.models.pipeline_lm import (pp_lm_loss, pp_lm_train_step,
                                            pp_stage_params, _pp_block)
-from marlin_tpu.models.transformer import (_head_logits, _rmsnorm,
-                                           init_transformer, synthetic_stream)
+from marlin_tpu.models.transformer import (_head_logits, _n_layers,
+                                           _rmsnorm, init_transformer,
+                                           synthetic_stream)
 
 
 @pytest.fixture
@@ -24,7 +25,7 @@ def mesh4():
 
 def _sequential_loss(params, tokens, heads):
     tokens = jnp.asarray(tokens)
-    n_layers = sum(1 for k in params if k.startswith("l") and k[1:].isdigit())
+    n_layers = _n_layers(params)
     x = params["emb"][tokens[:, :-1]]
     for i in range(n_layers):
         x = jax.vmap(lambda row, lp=params[f"l{i}"]: _pp_block(

@@ -68,22 +68,33 @@ def _mem(compiled):
 
 
 def lct_train_step(seq: int, mesh, compute_dtype=None,
-                   offload: bool = False, mlp_chunk=None) -> dict:
+                   offload: bool = False, mlp_chunk=None,
+                   n_experts=None, moe_group=8192) -> dict:
     """AOT-compile one lct_long training step (same knobs as config_lct_long:
     d256/h2/l2/v512, remat, loss_chunk=16k, ring_flash; optionally the bf16
-    activation path, host-offloaded residuals, and the chunked FFN)."""
+    activation path, host-offloaded residuals, the chunked FFN, or the MoE
+    FFN — ``n_experts`` swaps in grouped GShard top-2 routing + Switch aux,
+    the row proving expert routing keeps long-context memory linear in
+    seq)."""
     from marlin_tpu.utils.aot import trace_lm_train_step
 
     lm = TransformerLM(vocab=512, d_model=256, heads=2, layers=2,
                       attn="ring_flash", remat=True, loss_chunk=16384,
                       compute_dtype=compute_dtype, mlp_chunk=mlp_chunk,
-                      offload_residuals=offload)
+                      offload_residuals=offload, n_experts=n_experts,
+                      moe_group=moe_group)
     t0 = time.time()
     with mt.config_context(pallas_interpret=False):
         compiled = trace_lm_train_step(lm, seq, mesh).lower().compile()
     out = _mem(compiled)
     out["compile_s"] = round(time.time() - t0, 1)
     return out
+
+
+def moe_train_step(seq: int, mesh) -> dict:
+    """The MoE row of the report (docs/parallelism.md "Expert
+    parallelism"): the shared lct recipe with 8 experts."""
+    return lct_train_step(seq, mesh, n_experts=8)
 
 
 def attn_forward(seq: int, mesh) -> dict:
@@ -156,6 +167,13 @@ def main(seqs):
     for seq in seqs:
         print(f"[aot] attn_long seq={seq} ...", flush=True)
         report["attn_long"][str(seq)] = r = _try(attn_forward, seq, mesh)
+        print(f"  {_fmt(r)}", flush=True)
+    # MoE at the first (256k-class) rung: expert routing must not bend the
+    # linear-in-seq memory story
+    report.setdefault("moe_long_e8", {})
+    for seq in seqs[:1]:
+        print(f"[aot] moe_long_e8 seq={seq} ...", flush=True)
+        report["moe_long_e8"][str(seq)] = r = _try(moe_train_step, seq, mesh)
         print(f"  {_fmt(r)}", flush=True)
     # multi-chip: the budget table's "p chips train p× the context at the
     # same per-chip residency" claim, compiler-verified on a real 4-chip v5e

@@ -226,6 +226,20 @@ def test_moe_decode_compute_dtype():
     assert out.dtype == jnp.bfloat16
 
 
+def test_moe_bf16_training(mesh):
+    # mixed precision composes with MoE: bf16 activations route through f32
+    # gating and bf16 expert matmuls; the step learns and params stay f32
+    toks = mt.models.transformer.synthetic_stream(257, vocab=32, seed=4)
+    lm = TransformerLM(vocab=32, d_model=16, heads=2, layers=2,
+                       learning_rate=1e-2, n_experts=4, moe_group=64,
+                       moe_capacity_factor=2.0, compute_dtype="bfloat16")
+    params, losses = lm.train(toks, steps=12, mesh=mesh)
+    assert losses[-1] < losses[0] * 0.9, losses
+    import jax.numpy as jnp
+
+    assert params["l0"]["moe"]["w1"].dtype == jnp.float32
+
+
 def test_moe_offload_structure_guard(mesh):
     toks = mt.models.transformer.synthetic_stream(33, vocab=16, seed=3)
     p = init_transformer(jax.random.key(2), 16, 16, 2, 2, n_experts=4,

@@ -235,8 +235,6 @@ def test_moe_bf16_training(mesh):
                        moe_capacity_factor=2.0, compute_dtype="bfloat16")
     params, losses = lm.train(toks, steps=12, mesh=mesh)
     assert losses[-1] < losses[0] * 0.9, losses
-    import jax.numpy as jnp
-
     assert params["l0"]["moe"]["w1"].dtype == jnp.float32
 
 

@@ -64,6 +64,11 @@ class MarlinConfig:
     # target regions touch every saved shard file re-downloads past this bound
     # instead of holding the whole global array on the host.
     ckpt_cache_bytes: int = 1 << 30
+    # Checkpoint retention: after each committed save, io.checkpoint.
+    # save_checkpoint prunes all but the newest `ckpt_keep` generations
+    # (0 = keep everything). ResilientLoop passes its own `keep` explicitly
+    # (default 3 — the fall-back depth when the latest generation is corrupt).
+    ckpt_keep: int = 0
 
 
 _config = MarlinConfig()

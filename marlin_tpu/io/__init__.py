@@ -7,6 +7,15 @@ from .text import (  # noqa: F401
     load_svm_den_vec_matrix,
     save_matrix,
 )
-from .checkpoint import save_checkpoint, load_checkpoint, save_sharded, load_sharded  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    save_checkpoint,
+    load_checkpoint,
+    save_sharded,
+    load_sharded,
+    CheckpointCorruptError,
+    list_generations,
+    prune_generations,
+    verify_generation,
+)
 from .fs import register_filesystem  # noqa: F401
 from .orbax_ckpt import OrbaxCheckpointer  # noqa: F401

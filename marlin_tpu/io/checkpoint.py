@@ -1,4 +1,4 @@
-"""Sharded array checkpointing and training-state checkpoint/resume.
+"""Sharded array checkpointing and crash-safe training-state checkpoint/resume.
 
 The reference has *no* training-state checkpointing — only text-format matrix
 persistence (SURVEY.md §5.4) — and it inherits fault tolerance from Spark's
@@ -14,25 +14,98 @@ Two layers:
 - :func:`save_checkpoint` / :func:`load_checkpoint` — a pytree-of-arrays
   training checkpoint with step counter, for the iterative workloads.
 
+Crash-safety protocol (exercised by tests/test_faults.py via
+:mod:`marlin_tpu.utils.faults`):
+
+- **Atomic commit** — every generation is a directory ``ckpt_<step>``. Local
+  saves stage into ``ckpt_<step>.tmp`` and commit via ``os.replace``; remote
+  paths (no atomic rename) write in place and commit by writing the
+  ``COMMITTED`` marker last. Readers refuse a marker-less generation, so a
+  write torn by a crash is never visible.
+- **Integrity** — every payload file's CRC32 and size are recorded in a
+  per-process ``integrity_<proc>.json`` manifest inside the generation and
+  re-verified on load; a mismatch raises :class:`CheckpointCorruptError`.
+- **Retention** — ``save_checkpoint(..., keep=k)`` (or the ``ckpt_keep``
+  config) prunes all but the newest ``k`` committed generations after each
+  commit; :func:`list_generations` lets readers walk backward to the newest
+  generation that still verifies.
+
 Paths may carry a URL scheme (``hdfs://``, ``s3://``, ``memory://`` …): they
-route through the :mod:`marlin_tpu.io.fs` hook, the checkpoint analog of the
-reference's save-matrices-to-HDFS regime (utils/MTUtils.scala:350-392).
-Local paths keep ``mmap`` shard reads.
+route through the :mod:`marlin_tpu.io.fs` hook — with retrying remote IO
+(:mod:`marlin_tpu.utils.retry`) — the checkpoint analog of the reference's
+save-matrices-to-HDFS regime (utils/MTUtils.scala:350-392). Local paths keep
+``mmap`` shard reads.
 """
 
 from __future__ import annotations
 
+import io as _io
 import json
 import os
+import re
+import zlib
 from collections import OrderedDict
 
 import jax
 import numpy as np
 
 from ..config import get_config
-from .fs import ensure_dir, join_path, list_names, local_path, open_path
+from ..utils import faults as _faults
+from .fs import (ensure_dir, join_path, list_names, local_path, open_path,
+                 remove_path)
 
-__all__ = ["save_sharded", "load_sharded", "save_checkpoint", "load_checkpoint"]
+__all__ = ["save_sharded", "load_sharded", "save_checkpoint", "load_checkpoint",
+           "CheckpointCorruptError", "list_generations", "prune_generations",
+           "verify_generation"]
+
+#: commit marker written last inside a generation directory — remote paths
+#: have no atomic rename, so the marker's existence IS the commit
+_COMMITTED = "COMMITTED"
+
+_GEN_DIR_RE = re.compile(r"ckpt_(\d+)")
+_GEN_NPZ_RE = re.compile(r"ckpt_(\d+)\.npz")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint generation exists but cannot be trusted: missing commit
+    marker (torn write), failed CRC32 verification, or an unreadable
+    integrity manifest. Recovery should fall back to an older generation."""
+
+
+def _gen_name(step: int) -> str:
+    return f"ckpt_{step:08d}"
+
+
+def _write_bytes(path: str, data) -> dict:
+    """Write ``data`` (bytes or a memoryview — callers pass
+    ``BytesIO.getbuffer()`` to avoid copying large payloads) to ``path`` and
+    return its integrity record. The CRC is computed from the *intended*
+    bytes, never read back from storage — a torn write therefore always
+    disagrees with the recorded checksum."""
+    _faults.fire("ckpt.write", path=path)
+    with open_path(path, "wb") as f:
+        f.write(data)
+    return {"crc32": zlib.crc32(data) & 0xFFFFFFFF, "bytes": len(data)}
+
+
+def _crc_of(path: str) -> tuple[int, int]:
+    """(crc32, size) of a file, streamed in bounded chunks."""
+    crc = 0
+    size = 0
+    with open_path(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return crc & 0xFFFFFFFF, size
+
+
+def _barrier(name: str) -> None:
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
 
 
 class _ByteLRU:
@@ -67,18 +140,54 @@ class _ByteLRU:
         self._bytes += data.nbytes
 
 
-def save_sharded(arr: jax.Array, path: str) -> None:
-    """Write one .npy per addressable shard + a JSON manifest."""
+def _clear_stale_shards(path: str) -> None:
+    """Drop leftover ``shard_*``/``manifest_*`` files before re-saving into an
+    existing directory. A save under a different sharding or process count
+    writes differently-named files, and :func:`_read_manifests` would happily
+    mix the stale ones into a restore. Proc 0 clears, with a barrier so no
+    process writes while another still clears. A filesystem that cannot
+    delete a stale file gets an error rather than a save that would restore
+    as a silent old/new mix — a failed save is recoverable, corrupt data is
+    not."""
+    multiproc = jax.process_count() > 1
+    if not multiproc or jax.process_index() == 0:
+        try:
+            names = list_names(path)
+        except (FileNotFoundError, OSError):
+            names = []
+        stuck = [n for n in names
+                 if (n.startswith("shard_") or n.startswith("manifest_"))
+                 and not remove_path(join_path(path, n))]
+        if stuck:
+            raise RuntimeError(
+                f"cannot clear stale shard files under {path} (filesystem "
+                f"without delete support?): {stuck} — re-saving here would "
+                "mix old and new shards on restore; save to a fresh "
+                "directory instead")
+    if multiproc:
+        _barrier("marlin_shard_clear")
+
+
+def save_sharded(arr: jax.Array, path: str) -> dict:
+    """Write one .npy per addressable shard + a JSON manifest. Returns the
+    integrity records ``{relname: {"crc32", "bytes"}}`` of the files this
+    process wrote (folded into the checkpoint-level integrity manifest by
+    :func:`save_checkpoint`)."""
     ensure_dir(path)
+    _clear_stale_shards(path)
+    integ: dict[str, dict] = {}
     shards = []
     for shard in arr.addressable_shards:
         fname = f"shard_{shard.replica_id}_{'_'.join(map(str, [s.start or 0 for s in shard.index]))}.npy"
-        with open_path(join_path(path, fname), "wb") as f:
-            np.save(f, np.asarray(shard.data))
+        buf = _io.BytesIO()
+        np.save(buf, np.asarray(shard.data))
+        rec = _write_bytes(join_path(path, fname), buf.getbuffer())
+        integ[fname] = rec
         shards.append({
             "file": fname,
             "index": [[s.start, s.stop] for s in shard.index],
             "replica_id": shard.replica_id,
+            "crc32": rec["crc32"],
         })
     manifest = {
         "shape": list(arr.shape),
@@ -86,8 +195,11 @@ def save_sharded(arr: jax.Array, path: str) -> None:
         "shards": shards,
         "process_index": jax.process_index(),
     }
-    with open_path(join_path(path, f"manifest_{jax.process_index()}.json"), "w") as f:
-        json.dump(manifest, f)
+    mname = f"manifest_{jax.process_index()}.json"
+    _faults.fire("ckpt.manifest", path=join_path(path, mname))
+    integ[mname] = _write_bytes(join_path(path, mname),
+                                json.dumps(manifest).encode())
+    return integ
 
 
 def _read_manifests(path: str):
@@ -181,68 +293,239 @@ def load_sharded(path: str, sharding=None) -> jax.Array:
         _read_region(path, files, tuple(full), shape, dtype))
 
 
-def save_checkpoint(state, path: str, step: int) -> None:
+def list_generations(path: str, committed_only: bool = True) -> list[int]:
+    """Sorted steps of the checkpoint generations under ``path``. With
+    ``committed_only`` (the default), a generation directory counts only when
+    its ``COMMITTED`` marker exists — torn or in-progress writes are invisible.
+    Legacy single-file ``ckpt_<step>.npz`` generations (whose single rename
+    was their commit) always count. Returns [] when ``path`` doesn't exist."""
+    try:
+        names = list_names(path)
+    except (FileNotFoundError, OSError):
+        return []
+    steps = set()
+    for n in names:
+        m = _GEN_NPZ_RE.fullmatch(n)
+        if m:
+            steps.add(int(m.group(1)))
+            continue
+        m = _GEN_DIR_RE.fullmatch(n)
+        if not m:
+            continue
+        if not committed_only:
+            steps.add(int(m.group(1)))
+            continue
+        try:
+            if _COMMITTED in list_names(join_path(path, n)):
+                steps.add(int(m.group(1)))
+        except (FileNotFoundError, OSError):
+            continue
+    return sorted(steps)
+
+
+def verify_generation(path: str, step: int) -> None:
+    """Check one committed generation's integrity: the ``COMMITTED`` marker
+    exists and every file recorded in the integrity manifests matches its
+    CRC32 and size. Raises :class:`CheckpointCorruptError` otherwise. A
+    legacy single-file ``ckpt_<step>.npz`` generation carries no integrity
+    data and passes vacuously (its single rename was its commit)."""
+    try:
+        names = list_names(path)
+    except (FileNotFoundError, OSError):
+        names = []
+    if _gen_name(step) not in names and f"{_gen_name(step)}.npz" in names:
+        return
+    _verify_generation(join_path(path, _gen_name(step)))
+
+
+def _verify_generation(base: str) -> None:
+    try:
+        names = list_names(base)
+    except (FileNotFoundError, OSError) as e:
+        raise CheckpointCorruptError(f"{base}: unreadable generation: {e}") from e
+    if _COMMITTED not in names:
+        raise CheckpointCorruptError(
+            f"{base}: no {_COMMITTED} marker — torn or in-progress write")
+    manifests = [n for n in names
+                 if n.startswith("integrity_") and n.endswith(".json")]
+    if not manifests:
+        raise CheckpointCorruptError(
+            f"{base}: committed but carries no integrity manifest")
+    for mn in manifests:
+        try:
+            with open_path(join_path(base, mn)) as f:
+                man = json.load(f)
+            files = man["files"]
+        except (ValueError, KeyError, OSError) as e:  # JSONDecodeError is a
+            raise CheckpointCorruptError(               # ValueError
+                f"{base}/{mn}: unreadable integrity manifest: {e!r}") from e
+        for rel, rec in files.items():
+            try:
+                crc, size = _crc_of(join_path(base, rel))
+            except (FileNotFoundError, OSError) as e:
+                raise CheckpointCorruptError(
+                    f"{base}/{rel}: listed in {mn} but unreadable: {e}") from e
+            if size != rec["bytes"] or crc != rec["crc32"]:
+                raise CheckpointCorruptError(
+                    f"{base}/{rel}: checksum mismatch — manifest says "
+                    f"crc32={rec['crc32']} bytes={rec['bytes']}, file has "
+                    f"crc32={crc} bytes={size}")
+
+
+def prune_generations(path: str, keep: int) -> list[int]:
+    """Delete all but the newest ``keep`` committed generations; returns the
+    pruned steps. Torn debris — marker-less generation directories and
+    ``.tmp`` staging dirs — older than the newest committed generation is
+    also reclaimed (it is exactly what crashes leave behind; anything at or
+    past the newest commit might be a writer still in flight and is left
+    alone). Deletion is best-effort (a remote filesystem without ``rm``
+    keeps its extra generations rather than failing the save)."""
+    if keep <= 0:
+        return []
+    committed = list_generations(path)
+    if not committed:
+        return []
+    pruned = []
+    for step in committed[:-keep]:
+        removed = remove_path(join_path(path, _gen_name(step)), recursive=True)
+        removed = remove_path(join_path(path, _gen_name(step) + ".npz")) or removed
+        if removed:
+            pruned.append(step)
+    try:
+        names = list_names(path)
+    except (FileNotFoundError, OSError):
+        return pruned
+    newest = committed[-1]
+    for n in names:
+        m = _GEN_DIR_RE.fullmatch(n[:-4]) if n.endswith(".tmp") else None
+        if m is None:
+            m = _GEN_DIR_RE.fullmatch(n)
+            if m is None or int(m.group(1)) in committed:
+                continue
+        if int(m.group(1)) < newest:
+            remove_path(join_path(path, n), recursive=True)
+    return pruned
+
+
+def save_checkpoint(state, path: str, step: int, keep: int | None = None) -> None:
     """Save a pytree-of-arrays training state (weights, optimizer moments, …).
 
-    Single-process state goes into one ``.npz``. When any leaf spans
+    Single-process state goes into one ``state.npz``. When any leaf spans
     processes (a multi-host global array is not fully addressable, so it can
     never be device_get into one file), the checkpoint switches to a
-    per-leaf directory layout: each global leaf becomes a :func:`save_sharded`
+    per-leaf layout: each global leaf becomes a :func:`save_sharded`
     directory in which every process writes only its own shards — the restore
     side (:func:`load_checkpoint`) reads either layout, on ANY process count,
     which is what makes checkpoint-based *process elasticity* work
-    (SURVEY.md §5.3: save under N processes, resume under M)."""
+    (SURVEY.md §5.3: save under N processes, resume under M).
+
+    Either way the payload lands inside one generation directory that is
+    committed atomically (local: staged in ``ckpt_<step>.tmp`` and renamed;
+    remote: ``COMMITTED`` marker written last) with per-file CRC32s in an
+    integrity manifest — a reader can never observe a torn checkpoint.
+
+    ``keep`` bounds retention to the newest ``keep`` committed generations
+    (None defers to the ``ckpt_keep`` config; 0 keeps everything).
+    """
     ensure_dir(path)
-    leaves, treedef = jax.tree.flatten(state)
+    final = join_path(path, _gen_name(step))
+    _faults.fire("ckpt.write", path=final, step=step)
+    leaves, _ = jax.tree.flatten(state)
     spans = [x for x in leaves
              if isinstance(x, jax.Array) and not x.is_fully_addressable]
     multiproc = jax.process_count() > 1
+    proc = jax.process_index()
+    lp = local_path(path)
+    if lp is not None:
+        # local: stage, then commit via atomic rename
+        work = final + ".tmp"
+        if proc == 0:
+            remove_path(work, recursive=True)   # debris of a crashed attempt
+            remove_path(final, recursive=True)  # re-save of the same step
+    else:
+        # remote: no atomic rename — write in place, the marker commits.
+        # A same-step re-save first drops the whole old generation; where the
+        # filesystem can't delete trees, withdraw at least the marker and the
+        # stale integrity manifests (a re-save under fewer processes would
+        # otherwise leave integrity_<proc>.json files naming deleted shards,
+        # and the healthy new generation would fail verification).
+        work = final
+        if proc == 0 and not remove_path(final, recursive=True):
+            remove_path(join_path(final, _COMMITTED))
+            try:
+                for n in list_names(final):
+                    if n.startswith("integrity_"):
+                        remove_path(join_path(final, n))
+            except (FileNotFoundError, OSError):
+                pass
+    if multiproc:
+        _barrier(f"marlin_ckpt_stage_{step}")
+    integ: dict[str, dict] = {}
     if not spans:
         # fully-addressable state in a multi-process job: one writer (proc 0)
         # — concurrent same-file npz writes from every process would tear
-        if not multiproc or jax.process_index() == 0:
-            with open_path(join_path(path, f"ckpt_{step:08d}.npz"), "wb") as f:
-                np.savez(
-                    f,
-                    **{f"leaf_{i}": np.asarray(jax.device_get(x))
-                       for i, x in enumerate(leaves)},
-                )
-        if multiproc:
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices(f"marlin_ckpt_npz_{step}")
+        if not multiproc or proc == 0:
+            ensure_dir(work)
+            buf = _io.BytesIO()
+            np.savez(buf, **{f"leaf_{i}": np.asarray(jax.device_get(x))
+                             for i, x in enumerate(leaves)})
+            integ["state.npz"] = _write_bytes(join_path(work, "state.npz"),
+                                              buf.getbuffer())
     else:
-        base = join_path(path, f"ckpt_{step:08d}")
-        ensure_dir(base)
+        ensure_dir(work)
         for i, x in enumerate(leaves):
             if isinstance(x, jax.Array) and not x.is_fully_addressable:
-                save_sharded(x, join_path(base, f"leaf_{i}"))
-            elif jax.process_index() == 0:  # replicated/small leaves: once
-                with open_path(join_path(base, f"leaf_{i}.npy"), "wb") as f:
-                    np.save(f, np.asarray(jax.device_get(x)))
-        # every process reaches here with its shards durably written before
-        # 'latest' flips — a torn checkpoint is never the latest one
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices(f"marlin_ckpt_{step}")
-    # single-writer 'latest' (ADVICE r4): identical concurrent writes are
-    # benign on POSIX but undefined through remote-FS hooks (object stores
-    # can fail or tear concurrent same-object puts) — proc 0 alone flips the
-    # pointer, after the shard barrier above guaranteed durability. The
-    # trailing barrier keeps save_checkpoint's postcondition ("latest points
-    # at this step on return") true on EVERY process, not just proc 0.
-    if jax.process_index() == 0:
+                sub = save_sharded(x, join_path(work, f"leaf_{i}"))
+                integ.update({f"leaf_{i}/{k}": v for k, v in sub.items()})
+            elif proc == 0:  # replicated/small leaves: once
+                buf = _io.BytesIO()
+                np.save(buf, np.asarray(jax.device_get(x)))
+                integ[f"leaf_{i}.npy"] = _write_bytes(
+                    join_path(work, f"leaf_{i}.npy"), buf.getbuffer())
+    if integ:
+        mname = f"integrity_{proc}.json"
+        _faults.fire("ckpt.manifest", path=join_path(work, mname))
+        _write_bytes(join_path(work, mname),
+                     json.dumps({"step": step, "files": integ}).encode())
+    # every process reaches here with its shards durably written before the
+    # generation commits — a torn checkpoint is never visible to a reader
+    if multiproc:
+        _barrier(f"marlin_ckpt_payload_{step}")
+    # single-writer commit + 'latest' (ADVICE r4): identical concurrent
+    # writes are benign on POSIX but undefined through remote-FS hooks
+    # (object stores can fail or tear concurrent same-object puts) — proc 0
+    # alone commits and flips the pointer, after the payload barrier above
+    # guaranteed durability. The trailing barrier keeps save_checkpoint's
+    # postcondition ("this step is committed and latest on return") true on
+    # EVERY process, not just proc 0.
+    if proc == 0:
+        with open_path(join_path(work, _COMMITTED), "w") as f:
+            f.write(f"{step}\n")
+        if lp is not None:
+            os.replace(os.path.join(lp, _gen_name(step) + ".tmp"),
+                       os.path.join(lp, _gen_name(step)))
+    if multiproc:
+        _barrier(f"marlin_ckpt_commit_{step}")
+    if proc == 0:
         with open_path(join_path(path, "latest"), "w") as f:
             f.write(str(step))
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    if multiproc:
+        _barrier(f"marlin_ckpt_latest_{step}")
+    if keep is None:
+        keep = get_config().ckpt_keep
+    if keep and proc == 0:
+        prune_generations(path, keep)
 
-        multihost_utils.sync_global_devices(f"marlin_ckpt_latest_{step}")
 
-
-def load_checkpoint(state_like, path: str, step: int | None = None):
+def load_checkpoint(state_like, path: str, step: int | None = None,
+                    verify: bool = True):
     """Restore a checkpoint into the structure of ``state_like``.
-    Returns (state, step). ``step=None`` loads the latest.
+    Returns (state, step). ``step=None`` loads the newest *committed*
+    generation; a torn (marker-less) generation is never eligible, and with
+    ``verify`` (the default) every file is CRC32-checked against the
+    integrity manifest first — corruption raises
+    :class:`CheckpointCorruptError` so recovery can fall back to an older
+    generation (see :meth:`ResilientLoop._try_resume`).
 
     ``state_like`` is a real template, not just a treedef: restored leaves
     must match its shapes/dtypes (a mismatch means the checkpoint belongs to a
@@ -250,18 +533,41 @@ def load_checkpoint(state_like, path: str, step: int | None = None):
     and each leaf is re-placed onto the template leaf's sharding so
     tensor/data-parallel placements survive the restore."""
     if step is None:
-        with open_path(join_path(path, "latest")) as f:
-            step = int(f.read().strip())
-    if f"ckpt_{step:08d}" in set(list_names(path)):
-        return _load_checkpoint_dir(state_like, path, step), step
-    lp = local_path(path)
-    if lp is not None:
-        data = np.load(os.path.join(lp, f"ckpt_{step:08d}.npz"))
-    else:
-        import io as _io
+        gens = list_generations(path)
+        if gens:
+            step = gens[-1]
+        else:
+            # legacy pointer-file discovery (pre-atomic-commit layouts)
+            with open_path(join_path(path, "latest")) as f:
+                step = int(f.read().strip())
+    gname = _gen_name(step)
+    names = set(list_names(path))
+    if gname in names:
+        base = join_path(path, gname)
+        sub = set(list_names(base))
+        if _COMMITTED not in sub:
+            raise CheckpointCorruptError(
+                f"{base}: no {_COMMITTED} marker — torn or in-progress write")
+        if verify:
+            _verify_generation(base)
+        if "state.npz" in sub:
+            return _load_npz(state_like, join_path(base, "state.npz"),
+                             path, step), step
+        return _load_checkpoint_dir(state_like, base, path, step), step
+    if f"{gname}.npz" in names:  # legacy single-file layout
+        return _load_npz(state_like, join_path(path, f"{gname}.npz"),
+                         path, step), step
+    raise FileNotFoundError(f"no checkpoint for step {step} under {path}")
 
+
+def _load_npz(state_like, npz_path: str, path: str, step: int):
+    """Restore the single-file npz layout (template-validated)."""
+    lp = local_path(npz_path)
+    if lp is not None:
+        data = np.load(lp)
+    else:
         # npz is a zip: needs a seekable stream; buffer the remote read
-        with open_path(join_path(path, f"ckpt_{step:08d}.npz"), "rb") as f:
+        with open_path(npz_path, "rb") as f:
             data = np.load(_io.BytesIO(f.read()))
     leaves, treedef = jax.tree.flatten(state_like)
     n_stored = sum(1 for k in data.files if k.startswith("leaf_"))
@@ -285,18 +591,15 @@ def load_checkpoint(state_like, path: str, step: int | None = None):
         if isinstance(tmpl, jax.Array) and hasattr(tmpl, "sharding"):
             leaf = jax.device_put(leaf, tmpl.sharding)
         new_leaves.append(leaf)
-    return jax.tree.unflatten(treedef, new_leaves), step
+    return jax.tree.unflatten(treedef, new_leaves)
 
 
-def _load_checkpoint_dir(state_like, path: str, step: int):
+def _load_checkpoint_dir(state_like, base: str, path: str, step: int):
     """Restore the per-leaf directory layout written by a multi-process save.
     Global leaves restore through :func:`load_sharded` onto the TEMPLATE
     leaf's sharding — the current run's process count and mesh, not the
     saving run's — so a 2-process checkpoint resumes cleanly in 1 process
     and vice versa (the region reads pull only the overlapping shard files)."""
-    import re
-
-    base = join_path(path, f"ckpt_{step:08d}")
     leaves, treedef = jax.tree.flatten(state_like)
     names = set(list_names(base))
     n_stored = sum(1 for n in names if re.fullmatch(r"leaf_\d+(\.npy)?", n))

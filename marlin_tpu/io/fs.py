@@ -10,18 +10,30 @@ which needs a real file descriptor.
 
 A "filesystem" here is anything with the small fsspec surface the loaders
 use: ``open(path, mode)``, ``ls(path)``, ``isdir(path)``, ``isfile(path)``,
-``makedirs(path, exist_ok=True)``.
+``makedirs(path, exist_ok=True)``. Deletion (``rm``) is optional — helpers
+that delete degrade to no-ops on filesystems without it.
+
+Remote opens and listings are transient-failure territory (object stores,
+network filesystems), so they run through the process
+:class:`~marlin_tpu.utils.retry.RetryPolicy`; local paths keep the direct
+syscall fast path. Both routes pass the ``fs.open``/``fs.list`` fault points
+(:mod:`marlin_tpu.utils.faults`) so chaos tests can exercise exactly these
+seams.
 """
 
 from __future__ import annotations
 
 import os
 import re
+import shutil
 from typing import Iterator
+
+from ..utils import faults as _faults
+from ..utils.retry import get_retry_policy
 
 __all__ = ["register_filesystem", "get_filesystem", "split_scheme",
            "local_path", "open_path", "iter_lines", "make_parent_dirs",
-           "join_path", "ensure_dir", "list_names"]
+           "join_path", "ensure_dir", "list_names", "remove_path"]
 
 _SCHEME = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
 _REGISTRY: dict[str, object] = {}
@@ -88,11 +100,24 @@ def local_path(path: str) -> str | None:
 
 
 def open_path(path: str, mode: str = "r"):
-    """Open a local or remote path for reading/writing text."""
+    """Open a local or remote path for reading/writing. Remote opens retry
+    through the process :class:`~marlin_tpu.utils.retry.RetryPolicy`
+    (transient object-store errors must not kill a checkpoint); write handles
+    pass through the ``fs.open`` fault point so torn-write chaos tests can
+    truncate them."""
     fs, remote = get_filesystem(path)
     if not remote:
-        return open(_strip_file_scheme(path), mode)
-    return fs.open(path, mode)
+        _faults.fire("fs.open", path=path, mode=mode)
+        f = open(_strip_file_scheme(path), mode)
+    else:
+        def _attempt():
+            _faults.fire("fs.open", path=path, mode=mode)
+            return fs.open(path, mode)
+
+        f = get_retry_policy().call(_attempt, describe=f"open {path}")
+    if "w" in mode or "a" in mode or "+" in mode:
+        f = _faults.wrap_file("fs.open", f, path=path, mode=mode)
+    return f
 
 
 def iter_lines(path: str) -> Iterator[str]:
@@ -141,12 +166,46 @@ def ensure_dir(path: str) -> None:
 
 
 def list_names(path: str) -> list[str]:
-    """Sorted base names of a directory's entries (local or remote)."""
+    """Sorted base names of a directory's entries (local or remote). Remote
+    listings retry through the process RetryPolicy."""
     fs, remote = get_filesystem(path)
     if not remote:
+        _faults.fire("fs.list", path=path)
         return sorted(os.listdir(_strip_file_scheme(path)))
-    return sorted(str(p).rstrip("/").rsplit("/", 1)[-1]
-                  for p in fs.ls(path, detail=False))
+
+    def _attempt():
+        _faults.fire("fs.list", path=path)
+        return fs.ls(path, detail=False)
+
+    listing = get_retry_policy().call(_attempt, describe=f"list {path}")
+    return sorted(str(p).rstrip("/").rsplit("/", 1)[-1] for p in listing)
+
+
+def remove_path(path: str, recursive: bool = False) -> bool:
+    """Best-effort delete of a file or (with ``recursive``) a tree; returns
+    whether anything was removed. Remote filesystems without ``rm`` support —
+    the registered-filesystem contract makes deletion optional — return
+    False instead of raising, so retention/cleanup degrades to keeping extra
+    data rather than failing a save."""
+    fs, remote = get_filesystem(path)
+    if not remote:
+        p = _strip_file_scheme(path)
+        try:
+            if os.path.isdir(p):
+                if recursive:
+                    shutil.rmtree(p)
+                else:
+                    os.rmdir(p)
+            else:
+                os.remove(p)
+        except OSError:  # missing, non-empty, permission-denied: all "kept"
+            return False
+        return True
+    try:
+        fs.rm(path, recursive=recursive)
+        return True
+    except (OSError, AttributeError, NotImplementedError):
+        return False
 
 
 def make_parent_dirs(path: str) -> str:

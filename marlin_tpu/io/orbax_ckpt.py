@@ -21,6 +21,8 @@ import os
 
 import jax
 
+from ..utils import faults as _faults
+
 __all__ = ["OrbaxCheckpointer"]
 
 
@@ -51,7 +53,11 @@ class OrbaxCheckpointer:
         )
 
     def save(self, state, step: int) -> None:
-        """Queue an (async by default) save of the pytree ``state``."""
+        """Queue an (async by default) save of the pytree ``state``. Fires
+        the ``ckpt.write`` fault point so chaos tests can target the orbax
+        path with the same harness as the self-contained layer."""
+        _faults.fire("ckpt.write", path=os.path.join(self._dir, str(step)),
+                     step=step)
         self._mgr.save(step, args=self._ocp.args.StandardSave(state))
 
     def restore(self, state_like, step: int | None = None):

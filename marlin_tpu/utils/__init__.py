@@ -1,6 +1,15 @@
 from .profiling import timer, evaluate, StepTimer, trace  # noqa: F401
-from .tracing import annotate, EventLog, matmul_flops, effective_gflops  # noqa: F401
+from .tracing import (  # noqa: F401
+    annotate,
+    EventLog,
+    matmul_flops,
+    effective_gflops,
+    get_default_event_log,
+    set_default_event_log,
+)
 from .failure import ResilientLoop, heartbeat, NonFiniteLossError  # noqa: F401
+from .retry import RetryPolicy, get_retry_policy, set_retry_policy  # noqa: F401
+from . import faults  # noqa: F401
 from .mtutils import (  # noqa: F401
     random_den_vec_matrix,
     random_block_matrix,

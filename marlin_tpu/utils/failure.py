@@ -13,17 +13,27 @@ therefore makes recovery an explicit subsystem:
 - :func:`heartbeat` — a lightweight liveness probe: runs a trivial jitted op
   on every device and reports per-device latency; a hung/failed device shows
   up as a timeout instead of a silent stall.
+
+Both are chaos-tested through :mod:`marlin_tpu.utils.faults`: the ``step.run``
+point fires before every step (and can mutate its metric — NaN injection),
+``device.probe`` fires inside every heartbeat probe, and the checkpoint IO
+underneath carries its own points. Recovery walks *backward* through committed
+checkpoint generations — a torn or corrupt latest generation
+(:class:`~marlin_tpu.io.checkpoint.CheckpointCorruptError`) falls back to the
+newest one that still verifies instead of killing the run.
 """
 
 from __future__ import annotations
 
 import time
+import zipfile
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 
-from ..io.checkpoint import load_checkpoint, save_checkpoint
+from . import faults as _faults
+from .tracing import get_default_event_log
 
 __all__ = ["ResilientLoop", "heartbeat", "NonFiniteLossError"]
 
@@ -34,11 +44,26 @@ class NonFiniteLossError(RuntimeError):
     same remedy as a device loss."""
 
 
+#: What a generation may raise while being loaded that means "this generation
+#: is unusable, try an older one" rather than "abort": integrity failures
+#: (CheckpointCorruptError), vanished files (FileNotFoundError/OSError),
+#: truncated npy/npz payloads (ValueError/EOFError/BadZipFile), corrupt JSON
+#: manifests (JSONDecodeError is a ValueError), and mangled structures
+#: (KeyError). Broader than the old (FileNotFoundError, OSError) pair, which
+#: let a corrupt manifest or truncated array escape the recovery path
+#: entirely.
+def _resume_errors():
+    from ..io.checkpoint import CheckpointCorruptError
+
+    return (CheckpointCorruptError, FileNotFoundError, OSError, EOFError,
+            ValueError, KeyError, zipfile.BadZipFile)
+
+
 def heartbeat(timeout_s: float = 30.0, raise_on_failure: bool = True) -> dict:
     """Probe every visible device with a tiny computation; returns
     {device_str: latency_s}, with ``float('inf')`` marking devices that
     missed the deadline or raised (a dead device usually *errors* from the
-    runtime rather than hanging — those exceptions ride on the returned
+    runtime rather than hangs — those exceptions ride on the returned
     mapping as ``.errors``). All probes launch concurrently and every device
     is waited on against one shared deadline, so a single wedged device
     neither serializes the sweep nor hides the status of the devices behind
@@ -47,7 +72,12 @@ def heartbeat(timeout_s: float = 30.0, raise_on_failure: bool = True) -> dict:
     rides on the exception as ``.results``. A truly hung
     ``block_until_ready`` thread cannot be killed from Python; it is left as
     a daemon and never re-joined, so a stuck probe cannot wedge later
-    heartbeats."""
+    heartbeats.
+
+    The ``device.probe`` fault point fires inside each probe (ctx ``path`` is
+    the device string, so a fault can target one device): an injected raise
+    lands in ``.errors``, an injected delay past the deadline shows up as a
+    timeout — exactly how a real dead vs. wedged device presents."""
     import threading
 
     results: dict[str, float] = {}
@@ -57,6 +87,7 @@ def heartbeat(timeout_s: float = 30.0, raise_on_failure: bool = True) -> dict:
 
     def probe(d):
         try:
+            _faults.fire("device.probe", path=str(d), device=str(d))
             x = jax.device_put(jnp.ones(()), d)
             jax.block_until_ready(x + 1.0)
             with lock:
@@ -95,10 +126,18 @@ class ResilientLoop:
     """Run ``state, metric = step_fn(state, i)`` for ``iterations`` steps with
     checkpoint/resume fault tolerance.
 
-    On any runtime exception or non-finite metric, the loop restores the most
-    recent checkpoint and continues from there, up to ``max_retries`` times.
-    A fresh run resumes automatically if ``checkpoint_dir`` already holds a
-    checkpoint (crash-restart of the whole process).
+    On any runtime exception or non-finite metric — from the step itself *or*
+    from the checkpoint save (a transient IO failure must not kill a run) —
+    the loop restores the newest checkpoint generation that verifies and
+    continues from there, up to ``max_retries`` times. A fresh run resumes
+    automatically if ``checkpoint_dir`` already holds a committed checkpoint
+    (crash-restart of the whole process); a torn or corrupt latest generation
+    falls back to the one before it.
+
+    ``keep`` bounds on-disk retention to that many committed generations
+    (the fall-back depth); ``event_log`` (or the process default,
+    :func:`~marlin_tpu.utils.tracing.set_default_event_log`) receives
+    ``resume``/``resume_skip``/``step_failure`` events for post-mortems.
     """
 
     def __init__(
@@ -108,35 +147,99 @@ class ResilientLoop:
         checkpoint_every: int = 50,
         max_retries: int = 3,
         check_finite: bool = True,
+        keep: int = 3,
+        event_log=None,
     ):
         self.step_fn = step_fn
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = max(1, checkpoint_every)
         self.max_retries = max_retries
         self.check_finite = check_finite
+        self.keep = keep
+        self.event_log = event_log
         self.retries = 0
 
+    def _log(self, kind: str, **fields) -> None:
+        log = self.event_log or get_default_event_log()
+        if log is not None:
+            log.event(kind, **fields)
+
     def _try_resume(self, state_template):
-        """Restore the latest checkpoint; with none on disk, restart from the
-        pristine initial state (never from a possibly-corrupt current one)."""
-        try:
-            return load_checkpoint(state_template, self.checkpoint_dir)
-        except (FileNotFoundError, OSError):
-            return self._initial, 0
+        """Restore the newest checkpoint generation that loads and verifies,
+        walking backward past torn/corrupt ones; with none restorable,
+        restart from the pristine initial state (never from a
+        possibly-corrupt current one)."""
+        from ..io.checkpoint import list_generations, load_checkpoint
+
+        committed = list_generations(self.checkpoint_dir)
+        if not committed:
+            uncommitted = list_generations(self.checkpoint_dir,
+                                           committed_only=False)
+            if uncommitted:
+                # generation directories exist but none carries a COMMITTED
+                # marker: either torn writes, or checkpoints written before
+                # the atomic-commit protocol (docs/robustness.md explains
+                # the one-time migration) — restarting fresh must not be
+                # silent about either
+                import warnings
+
+                warnings.warn(
+                    f"ResilientLoop: {self.checkpoint_dir} holds generation "
+                    f"directories {uncommitted} but none is committed "
+                    "(torn writes, or pre-protocol checkpoints needing a "
+                    "one-time COMMITTED marker — see docs/robustness.md); "
+                    "restarting from the initial state",
+                    RuntimeWarning, stacklevel=3)
+        skipped = []
+        for step in reversed(committed):
+            try:
+                state, s = load_checkpoint(state_template,
+                                           self.checkpoint_dir, step=step)
+            except _resume_errors() as e:
+                self._log("resume_skip", step=step, error=repr(e))
+                skipped.append((step, e))
+                continue
+            self._log("resume", step=s)
+            return state, s
+        if skipped:
+            # checkpoints existed but NONE restored — restarting from scratch
+            # is the contract, but silently doing so would mask e.g. a
+            # template/configuration mismatch, so say it loudly
+            import warnings
+
+            warnings.warn(
+                f"ResilientLoop: no generation under {self.checkpoint_dir} "
+                f"was restorable — restarting from the initial state. "
+                "Skipped: "
+                + "; ".join(f"step {s}: {e!r}" for s, e in skipped),
+                RuntimeWarning, stacklevel=3)
+        return self._initial, 0
 
     def run(self, state, iterations: int):
+        from ..io.checkpoint import save_checkpoint
+
         self._initial = state
         state, start = self._try_resume(state)
         i = start
         metrics = []
         while i < iterations:
             try:
+                _faults.fire("step.run", step=i)
                 new_state, metric = self.step_fn(state, i)
                 m = float(metric)
+                m = _faults.mutate("step.run", m, step=i)
                 if self.check_finite and not (m == m and abs(m) != float("inf")):
                     raise NonFiniteLossError(f"non-finite metric {m} at step {i}")
-            except Exception:
+                state = new_state
+                metrics.append(m)
+                i += 1
+                if i % self.checkpoint_every == 0 or i == iterations:
+                    save_checkpoint(state, self.checkpoint_dir, i,
+                                    keep=self.keep)
+            except Exception as e:
                 self.retries += 1
+                self._log("step_failure", step=i, retry=self.retries,
+                          error=repr(e))
                 if self.retries > self.max_retries:
                     raise
                 state, i = self._try_resume(state)
@@ -144,9 +247,4 @@ class ResilientLoop:
                 # history has exactly one entry per step
                 del metrics[max(0, i - start):]
                 continue
-            state = new_state
-            metrics.append(m)
-            i += 1
-            if i % self.checkpoint_every == 0 or i == iterations:
-                save_checkpoint(state, self.checkpoint_dir, i)
         return state, metrics

@@ -22,7 +22,8 @@ from typing import Any
 
 import jax
 
-__all__ = ["annotate", "EventLog", "matmul_flops", "effective_gflops"]
+__all__ = ["annotate", "EventLog", "matmul_flops", "effective_gflops",
+           "set_default_event_log", "get_default_event_log"]
 
 
 @contextlib.contextmanager
@@ -67,3 +68,23 @@ class EventLog:
     def read(self) -> list[dict]:
         with open(self.path) as f:
             return [json.loads(line) for line in f if line.strip()]
+
+
+# Process-default event log: subsystems without a log handle of their own
+# (remote-IO retries in utils/retry.py, recovery events in utils/failure.py)
+# report here when one is installed, so a run's post-mortem record is one
+# stream rather than per-module fragments.
+_default_log: EventLog | None = None
+
+
+def set_default_event_log(log: EventLog | None) -> EventLog | None:
+    """Install (or, with None, remove) the process-default event log;
+    returns the previous one so callers can restore it."""
+    global _default_log
+    prev = _default_log
+    _default_log = log
+    return prev
+
+
+def get_default_event_log() -> EventLog | None:
+    return _default_log

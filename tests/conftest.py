@@ -13,6 +13,21 @@ import pytest
 import marlin_tpu as mt
 
 
+@pytest.fixture(autouse=True)
+def _no_fault_leaks():
+    """No injected fault may leak across tests: the chaos harness
+    (marlin_tpu.utils.faults) auto-deregisters exhausted faults and tests use
+    faults.injected(...) scoping, so a non-empty registry after a test is a
+    bug in that test — fail it loudly, but clear first so the leak doesn't
+    cascade into every later test."""
+    from marlin_tpu.utils import faults
+
+    yield
+    leaked = faults.active()
+    faults.clear()
+    assert not leaked, f"injected fault(s) leaked across tests: {leaked}"
+
+
 @pytest.fixture(scope="session")
 def mesh():
     """2-D (2×4) mesh — the BlockMatrix grid."""

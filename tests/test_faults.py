@@ -1,0 +1,615 @@
+"""Chaos suite: the recovery subsystem exercised against injected failures.
+
+The reference never tests its fault tolerance (Spark lineage is assumed to
+work, SURVEY.md §5.3); here every recovery guarantee is *demonstrated* under
+deterministic fault injection (marlin_tpu.utils.faults):
+
+- a checkpoint torn mid-write is never visible to a reader (atomic commit),
+- a committed-but-corrupt generation fails CRC verification and recovery
+  falls back to the previous generation,
+- a flaky remote filesystem succeeds through RetryPolicy with backoff, with
+  the retries visible in the EventLog,
+- NaN metrics and step crashes restart from the last good checkpoint with
+  exactly one metric entry per step,
+- heartbeat distinguishes erroring devices from wedged ones.
+
+Everything runs on the 8-device CPU mesh (JAX_PLATFORMS=cpu); long soak
+scenarios are marked `slow` and stay out of tier-1.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marlin_tpu.io.checkpoint import (
+    CheckpointCorruptError,
+    list_generations,
+    load_checkpoint,
+    load_sharded,
+    prune_generations,
+    save_checkpoint,
+    save_sharded,
+    verify_generation,
+)
+from marlin_tpu.io.fs import open_path
+from marlin_tpu.utils import faults
+from marlin_tpu.utils.failure import ResilientLoop, heartbeat
+from marlin_tpu.utils.faults import (
+    DelayFault,
+    FaultInjected,
+    MutateFault,
+    RaiseFault,
+    Schedule,
+    TornWriteFault,
+)
+from marlin_tpu.utils.retry import RetryPolicy, set_retry_policy
+from marlin_tpu.utils.tracing import EventLog
+
+
+# ---------------------------------------------------------------- helpers
+
+def _step_fn(state, i):
+    """Deterministic contraction toward 1.0; loss is comparable across
+    replays to fp exactness, so resumed trajectories equal uninterrupted
+    ones."""
+    w = state["w"] - 0.25 * (state["w"] - 1.0)
+    return {"w": w}, float(jnp.sum((w - 1.0) ** 2))
+
+
+def _oracle(iterations):
+    w = np.zeros((4,), np.float32)
+    out = []
+    for _ in range(iterations):
+        w = w - 0.25 * (w - 1.0)
+        out.append(float(np.sum((w - 1.0) ** 2)))
+    return out
+
+
+def _flip_byte(path, offset=-20):
+    """Corrupt one byte in place — a bit-rot / partial-overwrite stand-in."""
+    with open(path, "r+b") as f:
+        f.seek(offset, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _fast_policy(**kw):
+    """A RetryPolicy that never really sleeps (chaos tests stay fast)."""
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("seed", 0)
+    return RetryPolicy(**kw)
+
+
+# ------------------------------------------------------- harness mechanics
+
+def test_fault_registry_basics():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        faults.inject("no.such.point", RaiseFault())
+    f = faults.inject("step.run", RaiseFault(times=1))
+    assert faults.active() == {"step.run": [f]}
+    with pytest.raises(FaultInjected):
+        faults.fire("step.run", step=0)
+    # exhausted faults auto-deregister: nothing leaks into the next test
+    assert faults.active() == {}
+    faults.fire("step.run", step=1)  # no-op
+
+
+def test_injected_context_manager_removes_unfired():
+    with faults.injected("fs.open", RaiseFault(times=5)) as f:
+        assert faults.active()
+        with pytest.raises(FaultInjected):
+            faults.fire("fs.open", path="x")
+    assert faults.active() == {}
+    assert f.fired == 1
+
+
+def test_fault_match_gates_on_path():
+    with faults.injected("fs.open", RaiseFault(times=-1, match="target")):
+        faults.fire("fs.open", path="/elsewhere/file")  # no match, no fire
+        with pytest.raises(FaultInjected):
+            faults.fire("fs.open", path="/data/target/file")
+
+
+def test_schedule_seeded_reproducible():
+    a = Schedule(seed=123, rate=0.4)
+    b = Schedule(seed=123, rate=0.4)
+    pat_a = [a.should_fire() for _ in range(50)]
+    pat_b = [b.should_fire() for _ in range(50)]
+    assert pat_a == pat_b, "same seed must give the identical chaos schedule"
+    assert any(pat_a) and not all(pat_a)
+    c = Schedule(fire_on=[0, 3])
+    assert [c.should_fire() for _ in range(5)] == [True, False, False, True,
+                                                  False]
+
+
+def test_torn_write_truncates_and_flushes(tmp_path):
+    p = str(tmp_path / "torn.bin")
+    with faults.injected("fs.open",
+                         TornWriteFault(keep_bytes=10, then_raise=True)):
+        with pytest.raises(FaultInjected, match="torn write"):
+            with open_path(p, "wb") as f:
+                f.write(b"x" * 64)
+    assert os.path.getsize(p) == 10, "the durable prefix of a torn write"
+
+
+# ------------------------------------------------------------ retry policy
+
+def test_retry_backoff_grows_and_caps():
+    pol = RetryPolicy(max_attempts=6, base_delay=0.1, multiplier=2.0,
+                      max_delay=0.5, jitter=0.0, sleep=lambda s: None)
+    ds = list(pol.delays())
+    assert ds == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+
+def test_retry_jitter_seeded_reproducible():
+    d1 = list(RetryPolicy(max_attempts=5, jitter=0.25, seed=9,
+                          sleep=lambda s: None).delays())
+    d2 = list(RetryPolicy(max_attempts=5, jitter=0.25, seed=9,
+                          sleep=lambda s: None).delays())
+    assert d1 == d2
+    base = list(RetryPolicy(max_attempts=5, jitter=0.0,
+                            sleep=lambda s: None).delays())
+    assert all(j >= b for j, b in zip(d1, base)), "jitter only stretches"
+
+
+def test_retry_succeeds_after_transient_failures():
+    sleeps = []
+    pol = _fast_policy(max_attempts=4, base_delay=0.01, jitter=0.0,
+                       sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("transient")
+        return "ok"
+
+    assert pol.call(flaky, describe="unit") == "ok"
+    assert calls["n"] == 3 and pol.retries == 2
+    assert sleeps == [0.01, 0.02], "exponential backoff between attempts"
+
+
+def test_retry_exhausts_and_reraises():
+    pol = _fast_policy(max_attempts=3)
+    with pytest.raises(OSError, match="always"):
+        pol.call(lambda: (_ for _ in ()).throw(OSError("always")))
+
+
+def test_retry_deadline_with_injected_clock():
+    t = {"now": 0.0}
+
+    def clock():
+        return t["now"]
+
+    def sleep(s):
+        t["now"] += s
+
+    pol = RetryPolicy(max_attempts=100, base_delay=1.0, multiplier=1.0,
+                      jitter=0.0, deadline=3.5, clock=clock, sleep=sleep)
+    calls = {"n": 0}
+
+    def failing():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        pol.call(failing)
+    # budget 3.5s at 1s per backoff: attempts at t=0,1,2,3 then the next
+    # backoff would cross the deadline — fail fast instead of attempt 100
+    assert calls["n"] == 4
+
+
+def test_flaky_remote_fs_retries_visible_in_event_log(tmp_path):
+    """Acceptance: a remote fs raising on the first 2 of 3 open calls
+    succeeds through RetryPolicy, and the retries land in the EventLog."""
+    fsspec = pytest.importorskip("fsspec")
+    memfs = fsspec.filesystem("memory")
+    with memfs.open("/flaky_suite/data.txt", "w") as f:
+        f.write("payload")
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    prev = set_retry_policy(_fast_policy(max_attempts=4, base_delay=0.01,
+                                         event_log=log))
+    try:
+        with faults.injected(
+                "fs.open",
+                RaiseFault(OSError("flaky object store"), times=2,
+                           match="flaky_suite")) as fault:
+            with open_path("memory://flaky_suite/data.txt") as f:
+                assert f.read() == "payload"
+        assert fault.fired == 2, "first two opens failed, third succeeded"
+    finally:
+        set_retry_policy(prev)
+    retries = [e for e in log.read() if e["kind"] == "retry"]
+    assert [e["attempt"] for e in retries] == [1, 2]
+    assert all("flaky_suite" in e["op"] for e in retries)
+    assert retries[1]["delay_s"] > retries[0]["delay_s"] > 0
+
+
+def test_flaky_remote_listing_retries(tmp_path):
+    fsspec = pytest.importorskip("fsspec")
+    memfs = fsspec.filesystem("memory")
+    memfs.makedirs("/flaky_ls", exist_ok=True)
+    with memfs.open("/flaky_ls/a", "w") as f:
+        f.write("x")
+    from marlin_tpu.io.fs import list_names
+    prev = set_retry_policy(_fast_policy(max_attempts=3))
+    try:
+        with faults.injected("fs.list",
+                             RaiseFault(OSError("hiccup"), times=1,
+                                        match="flaky_ls")):
+            assert "a" in list_names("memory://flaky_ls")
+    finally:
+        set_retry_policy(prev)
+
+
+# ------------------------------------------------- crash-safe checkpointing
+
+def test_torn_checkpoint_never_committed(tmp_path):
+    d = str(tmp_path)
+    state = {"w": jnp.arange(8.0)}
+    with faults.injected("fs.open",
+                         TornWriteFault(keep_bytes=32, match="state.npz")):
+        with pytest.raises(FaultInjected):
+            save_checkpoint(state, d, step=1)
+    assert list_generations(d) == [], "a torn generation must be invisible"
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(state, d)
+
+
+def test_silent_truncation_caught_by_crc(tmp_path):
+    """A torn write that doesn't raise (power loss after a partial flush):
+    the generation commits, but CRC verification refuses it."""
+    d = str(tmp_path)
+    state = {"w": jnp.arange(8.0)}
+    with faults.injected(
+            "fs.open",
+            TornWriteFault(keep_bytes=64, then_raise=False,
+                           match="state.npz")):
+        save_checkpoint(state, d, step=1)
+    assert list_generations(d) == [1], "committed — the tear was silent"
+    with pytest.raises(CheckpointCorruptError, match="checksum mismatch"):
+        load_checkpoint(state, d)
+    with pytest.raises(CheckpointCorruptError):
+        verify_generation(d, 1)
+
+
+def test_remote_torn_checkpoint_marker_protocol():
+    """Remote paths have no atomic rename: the COMMITTED marker alone is the
+    commit, so a save killed before the marker leaves nothing loadable."""
+    pytest.importorskip("fsspec")
+    d = "memory://chaos_remote_torn/ck"
+    state = {"w": jnp.arange(4.0)}
+    with faults.injected("fs.open",
+                         TornWriteFault(keep_bytes=16, match="state.npz")):
+        with pytest.raises(FaultInjected):
+            save_checkpoint(state, d, step=2)
+    assert list_generations(d) == []
+    save_checkpoint(state, d, step=2)  # clean retry of the same step
+    restored, step = load_checkpoint(state, d)
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(4.0))
+
+
+def test_crash_before_manifest_not_committed(tmp_path):
+    d = str(tmp_path)
+    with faults.injected("ckpt.manifest", RaiseFault(OSError("died"))):
+        with pytest.raises(OSError):
+            save_checkpoint({"w": jnp.ones(3)}, d, step=5)
+    assert list_generations(d) == []
+
+
+def test_resilient_loop_falls_back_past_corrupt_latest(tmp_path):
+    """Acceptance: corrupt latest generation -> ResilientLoop resumes from
+    the previous committed one and completes with one metric per step."""
+    d = str(tmp_path)
+    loop = ResilientLoop(_step_fn, d, checkpoint_every=2)
+    loop.run({"w": jnp.zeros((4,), jnp.float32)}, 4)
+    assert list_generations(d) == [2, 4]
+    _flip_byte(str(tmp_path / "ckpt_00000004" / "state.npz"))
+
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    loop2 = ResilientLoop(_step_fn, d, checkpoint_every=2, event_log=log)
+    state, metrics = loop2.run({"w": jnp.zeros((4,), jnp.float32)}, 10)
+    assert len(metrics) == 8, "resumed at step 2 (gen 4 is corrupt)"
+    np.testing.assert_allclose(metrics, _oracle(10)[2:], rtol=1e-5)
+    events = [e["kind"] for e in log.read()]
+    assert "resume_skip" in events and "resume" in events
+    skip = next(e for e in log.read() if e["kind"] == "resume_skip")
+    assert skip["step"] == 4 and "checksum" in skip["error"]
+
+
+def test_kill_mid_save_then_process_restart(tmp_path):
+    """Acceptance: a save killed mid-write (torn staging dir) is never
+    loaded; a fresh process resumes from the previous committed generation."""
+    d = str(tmp_path)
+    loop1 = ResilientLoop(_step_fn, d, checkpoint_every=2, max_retries=0)
+    with faults.injected(
+            "fs.open",
+            TornWriteFault(keep_bytes=32, match="ckpt_00000004.tmp")):
+        with pytest.raises(FaultInjected):
+            loop1.run({"w": jnp.zeros((4,), jnp.float32)}, 4)
+    assert list_generations(d) == [2], "the torn gen 4 never committed"
+
+    loop2 = ResilientLoop(_step_fn, d, checkpoint_every=2)
+    state, metrics = loop2.run({"w": jnp.zeros((4,), jnp.float32)}, 10)
+    assert len(metrics) == 8
+    np.testing.assert_allclose(metrics, _oracle(10)[2:], rtol=1e-5)
+    # the retried save of step 4 committed, then retention (keep=3) rolled
+    # the window forward as the run progressed
+    assert list_generations(d) == [6, 8, 10]
+
+
+def test_resilient_loop_survives_transient_save_failure(tmp_path):
+    """A save failure mid-run counts as a failure like any other: resume,
+    replay, save again — the run completes without operator involvement."""
+    d = str(tmp_path)
+    loop = ResilientLoop(_step_fn, d, checkpoint_every=2)
+    with faults.injected(
+            "fs.open",
+            TornWriteFault(keep_bytes=32, match="ckpt_00000004.tmp")):
+        state, metrics = loop.run({"w": jnp.zeros((4,), jnp.float32)}, 6)
+    assert loop.retries == 1
+    assert len(metrics) == 6, "replayed steps keep one metric entry per step"
+    np.testing.assert_allclose(metrics, _oracle(6), rtol=1e-5)
+    assert list_generations(d)[-1] == 6
+
+
+def test_corrupt_integrity_manifest_falls_back(tmp_path):
+    """Satellite: a corrupt JSON manifest used to raise JSONDecodeError past
+    the old (FileNotFoundError, OSError) recovery filter."""
+    d = str(tmp_path)
+    loop = ResilientLoop(_step_fn, d, checkpoint_every=2)
+    loop.run({"w": jnp.zeros((4,), jnp.float32)}, 4)
+    with open(str(tmp_path / "ckpt_00000004" / "integrity_0.json"), "w") as f:
+        f.write("{ this is not json")
+    loop2 = ResilientLoop(_step_fn, d, checkpoint_every=2)
+    _, metrics = loop2.run({"w": jnp.zeros((4,), jnp.float32)}, 6)
+    assert len(metrics) == 4, "fell back to gen 2, replayed 2..6"
+
+
+def test_legacy_truncated_npz_falls_back(tmp_path):
+    """Satellite: a truncated legacy .npz raises ValueError/BadZipFile —
+    recovery must walk back to the older legacy generation, not crash."""
+    d = str(tmp_path)
+    w = np.full((4,), 0.4375, np.float32)  # the step-2 oracle state
+    np.savez(str(tmp_path / "ckpt_00000002.npz"), leaf_0=w)
+    with open(str(tmp_path / "ckpt_00000004.npz"), "wb") as f:
+        f.write(b"PK\x03\x04 truncated garbage")
+    with open(str(tmp_path / "latest"), "w") as f:
+        f.write("4")
+    loop = ResilientLoop(_step_fn, d, checkpoint_every=100)
+    state, metrics = loop.run({"w": jnp.zeros((4,), jnp.float32)}, 6)
+    assert len(metrics) == 4, "resumed from the intact legacy gen 2"
+    np.testing.assert_allclose(metrics, _oracle(6)[2:], rtol=1e-5)
+
+
+def test_all_generations_corrupt_warns_and_restarts(tmp_path):
+    """When checkpoints exist but NONE restores, the restart-from-scratch is
+    announced with a RuntimeWarning naming every skipped generation — a
+    config mismatch must never be silently absorbed as a fresh start."""
+    d = str(tmp_path)
+    loop = ResilientLoop(_step_fn, d, checkpoint_every=2)
+    loop.run({"w": jnp.zeros((4,), jnp.float32)}, 4)
+    for gen in (2, 4):
+        _flip_byte(str(tmp_path / f"ckpt_{gen:08d}" / "state.npz"))
+    loop2 = ResilientLoop(_step_fn, d, checkpoint_every=100)
+    with pytest.warns(RuntimeWarning, match="no generation .* was restorable"):
+        state, metrics = loop2.run({"w": jnp.zeros((4,), jnp.float32)}, 4)
+    assert len(metrics) == 4, "fresh restart still completes the run"
+    np.testing.assert_allclose(metrics, _oracle(4), rtol=1e-5)
+
+
+def test_verify_generation_legacy_npz_passes(tmp_path):
+    np.savez(str(tmp_path / "ckpt_00000003.npz"), leaf_0=np.zeros(2))
+    assert list_generations(str(tmp_path)) == [3]
+    verify_generation(str(tmp_path), 3)  # vacuous: no integrity data
+    with pytest.raises(CheckpointCorruptError):
+        verify_generation(str(tmp_path), 9)  # absent step still errors
+
+
+def test_retention_keeps_last_k(tmp_path):
+    d = str(tmp_path)
+    loop = ResilientLoop(_step_fn, d, checkpoint_every=2, keep=2)
+    loop.run({"w": jnp.zeros((4,), jnp.float32)}, 12)
+    assert list_generations(d) == [10, 12]
+    assert not (tmp_path / "ckpt_00000002").exists()
+    # pruning never touches the newest generations
+    restored, step = load_checkpoint({"w": jnp.zeros((4,), jnp.float32)}, d)
+    assert step == 12
+
+
+def test_prune_generations_direct(tmp_path):
+    d = str(tmp_path)
+    state = {"w": jnp.ones((2,))}
+    for s in (1, 2, 3, 4):
+        save_checkpoint(state, d, step=s)
+    assert list_generations(d) == [1, 2, 3, 4]
+    # torn debris older than the newest commit is reclaimed too: a marker-less
+    # generation dir and an orphaned staging dir (what crashes leave behind)
+    os.makedirs(str(tmp_path / "ckpt_00000002" / "junk"), exist_ok=True)
+    os.remove(str(tmp_path / "ckpt_00000002" / "COMMITTED"))
+    os.makedirs(str(tmp_path / "ckpt_00000003.tmp"), exist_ok=True)
+    assert prune_generations(d, keep=2) == [1]
+    assert list_generations(d) == [3, 4]
+    assert not (tmp_path / "ckpt_00000002").exists()
+    assert not (tmp_path / "ckpt_00000003.tmp").exists()
+    import marlin_tpu as mt
+    with mt.config_context(ckpt_keep=1):
+        save_checkpoint(state, d, step=5)  # keep=None defers to config
+    assert list_generations(d) == [5]
+
+
+def test_stale_shards_cleared_on_resave(tmp_path, mesh):
+    """Satellite: re-saving under a different sharding/process count must not
+    leave old shard_*/manifest_* files for _read_manifests to mix in."""
+    import marlin_tpu as mt
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    d = str(tmp_path / "arr")
+    a = mt.BlockMatrix.random(0, 40, 24, mesh=mesh)  # 2x4 mesh
+    save_sharded(a.data, d)
+    # simulate the leftover manifest of a second process from an older run
+    os.rename(os.path.join(d, "manifest_0.json"),
+              os.path.join(d, "manifest_1.json"))
+    old_files = set(os.listdir(d))
+
+    small = mt.create_mesh((4, 2), devices=jax.devices())
+    b = mt.BlockMatrix.random(1, 40, 24, mesh=small)
+    save_sharded(b.data, d)
+    names = set(os.listdir(d))
+    assert "manifest_1.json" not in names, "stale manifest survived"
+    man = json.load(open(os.path.join(d, "manifest_0.json")))
+    assert {n for n in names if n.startswith("shard_")} == \
+        {sh["file"] for sh in man["shards"]}, \
+        "only the new save's shard files may remain"
+    back = load_sharded(d, sharding=NamedSharding(small, P("rows", "cols")))
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(b.data))
+
+
+def test_sharded_manifest_records_crc(tmp_path, mesh):
+    import marlin_tpu as mt
+
+    d = str(tmp_path / "arr")
+    a = mt.BlockMatrix.random(2, 16, 8, mesh=mesh)
+    integ = save_sharded(a.data, d)
+    man = json.load(open(os.path.join(d, "manifest_0.json")))
+    for sh in man["shards"]:
+        assert sh["crc32"] == integ[sh["file"]]["crc32"]
+        import zlib
+        crc = zlib.crc32(open(os.path.join(d, sh["file"]), "rb").read())
+        assert (crc & 0xFFFFFFFF) == sh["crc32"]
+
+
+# ------------------------------------------------------- step-level faults
+
+def test_nan_metric_recovers_from_checkpoint(tmp_path):
+    """Acceptance: NaN injected into a step's metric triggers
+    NonFiniteLossError recovery; the finished history has one finite entry
+    per step."""
+    d = str(tmp_path)
+    with faults.injected("step.run", MutateFault(float("nan"), times=1)):
+        loop = ResilientLoop(_step_fn, d, checkpoint_every=2)
+        state, metrics = loop.run({"w": jnp.zeros((4,), jnp.float32)}, 6)
+    assert loop.retries == 1
+    assert len(metrics) == 6
+    assert all(np.isfinite(metrics))
+    np.testing.assert_allclose(metrics, _oracle(6), rtol=1e-5)
+
+
+def test_step_crash_recovers_from_checkpoint(tmp_path):
+    d = str(tmp_path)
+    fault = RaiseFault(RuntimeError("device lost mid-step"),
+                       schedule=Schedule(fire_on=[3]), times=1)
+    with faults.injected("step.run", fault):
+        loop = ResilientLoop(_step_fn, d, checkpoint_every=2)
+        state, metrics = loop.run({"w": jnp.zeros((4,), jnp.float32)}, 6)
+    assert fault.fired == 1 and loop.retries == 1
+    assert len(metrics) == 6
+    np.testing.assert_allclose(metrics, _oracle(6), rtol=1e-5)
+
+
+def test_retry_budget_exhaustion_reraises(tmp_path):
+    d = str(tmp_path)
+    with faults.injected("step.run",
+                         RaiseFault(RuntimeError("hard failure"), times=-1)):
+        loop = ResilientLoop(_step_fn, d, checkpoint_every=2, max_retries=2)
+        with pytest.raises(RuntimeError, match="hard failure"):
+            loop.run({"w": jnp.zeros((4,), jnp.float32)}, 6)
+        assert loop.retries == 3
+
+
+# ------------------------------------------------------- heartbeat failure
+
+def test_heartbeat_device_error_recorded():
+    """Satellite: a probe that ERRORS (dead device) lands in .errors with
+    latency inf; the healthy devices still report."""
+    dev0 = str(jax.devices()[0])
+    with faults.injected("device.probe",
+                         RaiseFault(RuntimeError("device gone"),
+                                    match=dev0)):
+        out = heartbeat(timeout_s=10.0, raise_on_failure=False)
+    assert out[dev0] == float("inf")
+    assert dev0 in out.errors and "device gone" in str(out.errors[dev0])
+    healthy = [v for k, v in out.items() if k != dev0]
+    assert len(healthy) == len(jax.devices()) - 1
+    assert all(v < 10.0 for v in healthy)
+
+
+def test_heartbeat_raise_on_failure_payload():
+    dev0 = str(jax.devices()[0])
+    with faults.injected("device.probe",
+                         RaiseFault(RuntimeError("bus error"), match=dev0)):
+        with pytest.raises(TimeoutError) as ei:
+            heartbeat(timeout_s=10.0, raise_on_failure=True)
+    err = ei.value
+    assert dev0 in str(err) and "bus error" in str(err)
+    assert err.results[dev0] == float("inf"), ".results rides on the error"
+    assert dev0 in err.results.errors
+
+
+def test_heartbeat_timeout_vs_error():
+    """A wedged (slow) device times out WITHOUT an .errors entry — the
+    distinction operators triage on."""
+    dev0 = str(jax.devices()[0])
+    with faults.injected("device.probe", DelayFault(2.0, match=dev0)):
+        out = heartbeat(timeout_s=0.25, raise_on_failure=False)
+    assert out[dev0] == float("inf")
+    assert dev0 not in out.errors, "slow is a timeout, not a device error"
+
+
+def test_heartbeat_happy_path_all_finite():
+    out = heartbeat(timeout_s=30.0)
+    assert set(out) == {str(d) for d in jax.devices()}
+    assert all(np.isfinite(v) for v in out.values())
+    assert out.errors == {}
+
+
+# ------------------------------------------------------------- slow chaos
+
+@pytest.mark.slow
+def test_soak_seeded_flaky_fs_full_run(tmp_path):
+    """Soak: a probabilistically flaky filesystem (seeded schedule, so the
+    chaos is reproducible) under a long checkpointed run — retries absorb
+    every transient, the trajectory matches the uninterrupted oracle."""
+    d = str(tmp_path)
+    prev = set_retry_policy(_fast_policy(max_attempts=6, base_delay=0.001))
+    try:
+        with faults.injected(
+                "step.run",
+                MutateFault(float("nan"), times=2,
+                            schedule=Schedule(seed=7, rate=0.05))), \
+             faults.injected(
+                "fs.open",
+                RaiseFault(OSError("flaky disk"), times=-1, match=d,
+                           schedule=Schedule(seed=11, rate=0.06))):
+            loop = ResilientLoop(_step_fn, d, checkpoint_every=5,
+                                 max_retries=10, keep=2)
+            state, metrics = loop.run({"w": jnp.zeros((4,), jnp.float32)}, 40)
+    finally:
+        set_retry_policy(prev)
+    assert len(metrics) == 40
+    np.testing.assert_allclose(metrics, _oracle(40), rtol=1e-5)
+    assert len(list_generations(d)) <= 2
+
+
+@pytest.mark.slow
+def test_soak_repeated_corruption_always_recovers(tmp_path):
+    """Soak: corrupt the newest generation between every run; each restart
+    still converges on the oracle trajectory from the newest intact one."""
+    d = str(tmp_path)
+    loop = ResilientLoop(_step_fn, d, checkpoint_every=2, keep=4)
+    loop.run({"w": jnp.zeros((4,), jnp.float32)}, 4)
+    for target in (8, 12, 16):
+        newest = list_generations(d)[-1]
+        _flip_byte(str(tmp_path / f"ckpt_{newest:08d}" / "state.npz"))
+        loop = ResilientLoop(_step_fn, d, checkpoint_every=2, keep=4)
+        state, metrics = loop.run({"w": jnp.zeros((4,), jnp.float32)},
+                                  target)
+        start = target - len(metrics)
+        np.testing.assert_allclose(metrics, _oracle(target)[start:],
+                                   rtol=1e-5)

@@ -197,20 +197,27 @@ def last_good_provenance():
 
 
 def same_round_measurement():
-    """This round's banked bench.py output (BENCH_PROBE_r*.json, written by
-    the recovery runner from this script's own stdout after a successful
-    on-chip run), if one exists, is fresh (< 24 h — a round lasts ~12 h),
-    and carries a real value. Returns the parsed record plus _src/_when
-    provenance fields, else None."""
+    """The current round's banked bench.py output (BENCH_PROBE_r*.json,
+    written by the recovery runner from this script's own stdout after a
+    successful on-chip run), if one exists and carries a real value. "Current
+    round" means: matching MARLIN_BENCH_ROUND when the runner pinned one
+    (BENCH_PROBE_r<round>.json), and in any case no older than one round
+    (MARLIN_BENCH_ROUND_HOURS, default 12 h) — a previous round's probe must
+    never be re-emitted as if it were this round's (ADVICE r5). Returns the
+    parsed record plus _src/_when provenance fields, else None."""
     import glob
     import time as _time
 
+    window_s = float(os.environ.get("MARLIN_BENCH_ROUND_HOURS", "12")) * 3600
+    round_id = os.environ.get("MARLIN_BENCH_ROUND", "")
     best = None
     for path in glob.glob(os.path.join(os.path.dirname(__file__) or ".",
                                        "BENCH_PROBE_r*.json")):
         try:
+            if round_id and os.path.basename(path) != f"BENCH_PROBE_{round_id}.json":
+                continue
             age = _time.time() - os.path.getmtime(path)
-            if age > 24 * 3600:
+            if age > window_s:
                 continue
             with open(path) as f:
                 rec = json.load(f)
@@ -252,12 +259,11 @@ def main():
         probe = same_round_measurement()
         if probe is not None:
             probe["note"] = (
-                "relay down at this invocation (" + err + "); value is this "
-                "round's real on-chip measurement of this same script, "
-                f"banked by tools/on_recovery.sh in {probe.pop('_src')} "
-                f"at {probe.pop('_when')} UTC")
-            log("re-emitting this round's banked on-chip measurement: "
-                + probe["note"])
+                f"banked measurement from {probe.pop('_src')} "
+                f"(written {probe.pop('_when')} UTC by tools/on_recovery.sh "
+                "from this same script's on-chip stdout); relay down at this "
+                "invocation (" + err + ")")
+            log("re-emitting banked measurement: " + probe["note"])
             print(json.dumps(probe))
             return
         log(f"device backend unavailable — emitting error record: {err}")

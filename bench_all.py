@@ -115,6 +115,7 @@ def _dense_config(n, reps, name, precision="high"):
 
 def config4():
     from marlin_tpu.parallel import streamed_gramian
+    from marlin_tpu.utils.profiling import StageTimes
 
     # BASELINE names 10^7 rows; GFLOP/s is row-count invariant for this
     # streamed kernel, and the relay tunnel's H2D bandwidth makes the full
@@ -122,6 +123,10 @@ def config4():
     rows = int(os.environ.get("MARLIN_BENCH_TALL_ROWS", 4_000_000))
     cols = 512
     chunk = int(os.environ.get("MARLIN_BENCH_CHUNK_ROWS", 1 << 19))
+    # MARLIN_BENCH_PREFETCH=0 forces the synchronous path (the before/after
+    # control for the async prefetch pipeline); default follows config (on)
+    prefetch = (False if os.environ.get("MARLIN_BENCH_PREFETCH") == "0"
+                else None)
     rng = np.random.default_rng(0)
 
     def chunks():
@@ -133,13 +138,22 @@ def config4():
 
     # warm-up compile on one chunk
     streamed_gramian(iter([np.zeros((1024, cols), np.float32)]))
+    stats = StageTimes()
     t0 = time.perf_counter()
-    g = streamed_gramian(chunks(), chunk_rows=chunk)
+    g = streamed_gramian(chunks(), chunk_rows=chunk, prefetch=prefetch,
+                         stats=stats)
     dt = time.perf_counter() - t0
     assert g.shape == (cols, cols)
+    # label from the RESOLVED mode: prefetch=None follows config, which may
+    # itself be off — the A/B record must say what actually ran
+    from marlin_tpu.config import get_config as _get_cfg
+
+    effective = _get_cfg().prefetch_enabled if prefetch is None else prefetch
+    mode = "prefetch" if effective else "sync"
     record(f"4_tall_skinny_{rows}x512_gramian_e2e",
            2 * rows * cols**2 / dt / 1e9, "GFLOP/s",
-           f"{dt:.1f} s end-to-end incl. host generation + relay H2D transfer")
+           f"{dt:.1f} s end-to-end incl. host generation + H2D transfer "
+           f"[{mode}; stages: {stats.summary()}]")
 
     # device-compute half of the split: the same per-chunk rank-update with
     # the operand already resident, sync-amortized over reps — what the

@@ -38,11 +38,15 @@ from .matrix import (  # noqa: F401
     SparseVecMatrix,
 )
 from .parallel import (  # noqa: F401
+    ChunkPrefetcher,
     matmul,
+    prefetch_chunks,
     ring_attention,
     ring_matmul,
     rmm_matmul,
     split_method,
+    streamed_gramian,
+    streamed_matmul,
     tune_multiply,
     ulysses_attention,
 )

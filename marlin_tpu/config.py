@@ -69,6 +69,28 @@ class MarlinConfig:
     # (0 = keep everything). ResilientLoop passes its own `keep` explicitly
     # (default 3 — the fall-back depth when the latest generation is corrupt).
     ckpt_keep: int = 0
+    # --- streaming prefetch (parallel/prefetch.py) ---------------------------
+    # Default for the async host→device prefetch pipeline behind the streamed
+    # ops (streamed_matmul/streamed_gramian, OutOfCoreMatrix). False falls
+    # back to the synchronous read→convert→upload loop on the caller's thread.
+    prefetch_enabled: bool = True
+    # Backpressure: at most this many chunks read-but-not-yet-consumed at
+    # once (the bounded queue depth). 2 = classic double buffering: chunk i+1
+    # is produced/transferred while the device computes on chunk i.
+    prefetch_depth: int = 2
+    # Producer threads. 1 suffices when the source read dominates; >1 overlaps
+    # dtype conversion/compression of several chunks (reads stay serialized —
+    # chunk sources are plain iterators).
+    prefetch_workers: int = 1
+    # In-flight HBM budget (bytes) for prefetched-but-unconsumed chunks; a
+    # producer blocks before device_put when the budget is full (at least one
+    # chunk is always allowed through). 0 = unbounded (depth alone bounds it).
+    prefetch_hbm_budget_bytes: int = 2 << 30
+    # --- autotune persistence (parallel/autotune.py) -------------------------
+    # Where the empirical multiply-strategy winners persist across processes.
+    # None = ~/.cache/marlin_tpu/autotune.json; "" disables the disk layer
+    # (in-process caching still works).
+    autotune_cache_path: str | None = None
 
 
 _config = MarlinConfig()

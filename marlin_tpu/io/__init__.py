@@ -1,11 +1,17 @@
 from .text import (  # noqa: F401
     load_matrix_file,
     load_matrix_files,
+    load_matrix_file_out_of_core,
+    iter_matrix_file_chunks,
     load_block_matrix_file,
     load_block_matrix_files,
     load_coordinate_matrix,
     load_svm_den_vec_matrix,
     save_matrix,
+)
+from .mnist import (  # noqa: F401
+    iter_mnist_image_chunks,
+    mnist_images_out_of_core,
 )
 from .checkpoint import (  # noqa: F401
     save_checkpoint,

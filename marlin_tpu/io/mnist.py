@@ -17,21 +17,60 @@ import struct
 
 import numpy as np
 
-__all__ = ["load_mnist_images", "load_mnist_labels", "synthetic_mnist"]
+__all__ = ["load_mnist_images", "load_mnist_labels", "synthetic_mnist",
+           "iter_mnist_image_chunks", "mnist_images_out_of_core"]
 
 
 def _open(path: str):
     return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
 
 
+def _read_idx3_header(f, path: str) -> tuple[int, int]:
+    magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+    if magic != 2051:
+        raise ValueError(f"{path}: bad idx3 magic {magic}")
+    return n, rows * cols
+
+
+def iter_mnist_image_chunks(path: str, chunk_rows: int = 1 << 14):
+    """idx3-ubyte images streamed as ``(≤chunk_rows, dim)`` float32 chunks in
+    [0, 1] without ever materializing the full file — the out-of-core feed
+    for datasets bigger than host RAM. Streamed consumers pull this through
+    the async prefetch pipeline, so the file read + ``/255`` normalization
+    happen on producer threads, off the device's critical path."""
+    with _open(path) as f:
+        n, dim = _read_idx3_header(f, path)
+        remaining = n
+        while remaining:
+            take = min(chunk_rows, remaining)
+            buf = f.read(take * dim)
+            if len(buf) != take * dim:
+                raise ValueError(
+                    f"{path}: truncated idx3 file ({remaining} of {n} rows "
+                    "unread at EOF)")
+            yield (np.frombuffer(buf, np.uint8).reshape(take, dim)
+                   / 255.0).astype(np.float32)
+            remaining -= take
+
+
+def mnist_images_out_of_core(path: str, chunk_rows: int = 1 << 14):
+    """:class:`~marlin_tpu.matrix.out_of_core.OutOfCoreMatrix` over an idx3
+    images file. The source is a re-iterable callable, so every streamed op
+    (multiply/gramian/sum) makes its own chunked pass over the file."""
+    from ..matrix.out_of_core import OutOfCoreMatrix
+
+    with _open(path) as f:
+        n, dim = _read_idx3_header(f, path)
+    return OutOfCoreMatrix(lambda: iter_mnist_image_chunks(path, chunk_rows),
+                           shape=(n, dim), chunk_rows=chunk_rows)
+
+
 def load_mnist_images(path: str) -> np.ndarray:
     """idx3-ubyte images → (n, 784) float32 in [0, 1]."""
     with _open(path) as f:
-        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
-        if magic != 2051:
-            raise ValueError(f"{path}: bad idx3 magic {magic}")
-        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
-    return (data.reshape(n, rows * cols) / 255.0).astype(np.float32)
+        n, dim = _read_idx3_header(f, path)
+        data = np.frombuffer(f.read(n * dim), np.uint8)
+    return (data.reshape(n, dim) / 255.0).astype(np.float32)
 
 
 def load_mnist_labels(path: str) -> np.ndarray:

@@ -83,6 +83,79 @@ def load_matrix_files(path: str, mesh=None):
     return load_matrix_file(path, mesh)
 
 
+def iter_matrix_file_chunks(path: str, chunk_rows: int = 4096):
+    """Row-format text streamed as dense ``(≤chunk_rows, n)`` float chunks,
+    never materializing the whole matrix — the out-of-core feed for files
+    bigger than host RAM. Each line's row index must equal its position
+    (contiguous 0..m-1, the order the reference's writers emit): streaming
+    cannot recover a shuffled or gapped file the way the buffering
+    :func:`load_matrix_file` can (it zero-fills gaps and reorders), so those
+    are rejected loudly rather than silently yielding a different matrix.
+    Streamed consumers pull this through the async prefetch pipeline, so
+    parsing happens on producer threads, overlapped with device compute."""
+    buf: list[np.ndarray] = []
+    ncols = None
+    row = 0  # parsed-row counter (blank lines skipped)
+    for lineno, line in enumerate(_iter_lines(path), start=1):
+        line = line.strip()
+        if not line:
+            continue
+        idx_part, sep, vals_part = line.partition(":")
+        if not sep:
+            raise ValueError(
+                f"{path}: line {lineno} is not row format (no ':'): "
+                f"{line[:60]!r}")
+        try:
+            idx = int(idx_part)
+        except ValueError:
+            raise ValueError(
+                f"{path}: line {lineno} has a non-integer row index "
+                f"{idx_part[:60]!r}") from None
+        if idx != row:
+            raise ValueError(
+                f"{path}: line {lineno} carries row index {idx}, expected "
+                f"{row} — chunked streaming needs contiguous in-order rows; "
+                "use load_matrix_file for gapped/shuffled files")
+        vals = np.array([float(x) for x in _SEP.split(vals_part.strip()) if x])
+        if ncols is None:
+            ncols = len(vals)
+        elif len(vals) != ncols:
+            raise ValueError(
+                f"{path}: line {lineno} (row {row}) has {len(vals)} values "
+                f"(expected {ncols}) — chunked streaming needs rectangular "
+                "rows")
+        buf.append(vals)
+        row += 1
+        if len(buf) >= chunk_rows:
+            yield np.stack(buf)
+            buf = []
+    if buf:
+        yield np.stack(buf)
+
+
+def load_matrix_file_out_of_core(path: str, chunk_rows: int = 4096):
+    """:class:`~marlin_tpu.matrix.out_of_core.OutOfCoreMatrix` over a
+    row-format text file: one cheap line-counting pass for the shape, then
+    each streamed op makes its own chunked parsing pass (re-iterable
+    callable source)."""
+    from ..matrix.out_of_core import OutOfCoreMatrix
+
+    nrows, ncols = 0, 0
+    for lineno, line in enumerate(_iter_lines(path), start=1):
+        line = line.strip()
+        if not line:
+            continue
+        if ncols == 0:
+            _idx, sep, vals_part = line.partition(":")
+            if not sep:
+                raise ValueError(f"{path}: line {lineno} is not row format "
+                                 f"(no ':'): {line[:60]!r}")
+            ncols = len([x for x in _SEP.split(vals_part.strip()) if x])
+        nrows += 1
+    return OutOfCoreMatrix(lambda: iter_matrix_file_chunks(path, chunk_rows),
+                           shape=(nrows, ncols), chunk_rows=chunk_rows)
+
+
 def _blocks_from_lines(lines):
     blocks = {}
     for line in lines:

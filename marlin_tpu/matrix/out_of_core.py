@@ -63,9 +63,12 @@ class OutOfCoreMatrix:
 
     # ------------------------------------------------------------ operations
     def multiply(self, other, out: np.ndarray | None = None,
-                 precision: str | None = None) -> np.ndarray | None:
+                 precision: str | None = None, prefetch: bool | None = None,
+                 stats=None) -> np.ndarray | None:
         """``self @ other`` with ``other`` resident on device; the result
-        streams back to host (or into ``out``, e.g. a writable memmap)."""
+        streams back to host (or into ``out``, e.g. a writable memmap).
+        Chunk production/upload runs on the async prefetch pipeline by default
+        (``prefetch``/``stats`` as in :func:`streamed_matmul`)."""
         other_arr = other.logical() if hasattr(other, "logical") else np.asarray(other)
         if other_arr.shape[0] != self.num_cols():
             raise ValueError(
@@ -74,14 +77,28 @@ class OutOfCoreMatrix:
         # _chunks() already yields chunk_rows-sized pieces; streamed_* consume
         # the iterator as-is
         return streamed_matmul(self._chunks(), other_arr, out=out,
-                               precision=precision)
+                               precision=precision, prefetch=prefetch,
+                               stats=stats)
 
-    def gramian(self, precision: str | None = None) -> np.ndarray:
+    def gramian(self, precision: str | None = None,
+                prefetch: bool | None = None, stats=None) -> np.ndarray:
         """``AᵀA`` with the n×n accumulator on device."""
-        return streamed_gramian(self._chunks(), precision=precision)
+        return streamed_gramian(self._chunks(), precision=precision,
+                                prefetch=prefetch, stats=stats)
 
-    def sum(self) -> float:
-        return float(sum(np.sum(c, dtype=np.float64) for c in self._chunks()))
+    def sum(self, prefetch: bool | None = None) -> float:
+        """Host-side total. Chunk production still overlaps the summation via
+        a host-only prefetcher (no device upload) — callable sources that
+        parse/generate are the cost here, not the adds."""
+        from ..config import get_config
+        from ..parallel.prefetch import ChunkPrefetcher
+
+        enabled = get_config().prefetch_enabled if prefetch is None else prefetch
+        chunks = self._chunks()
+        if not enabled:
+            return float(sum(np.sum(c, dtype=np.float64) for c in chunks))
+        with ChunkPrefetcher(chunks, device_put=False) as pf:
+            return float(sum(np.sum(c, dtype=np.float64) for c in pf))
 
     def slice_rows(self, start: int, stop: int) -> np.ndarray:
         """Materialize a host row range [start, stop)."""

@@ -4,5 +4,6 @@ from .ring import ring_matmul  # noqa: F401
 from .ring_attention import ring_attention, attention_reference  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
 from .streaming import streamed_matmul, streamed_gramian  # noqa: F401
+from .prefetch import ChunkPrefetcher, prefetch_chunks  # noqa: F401
 from .autotune import tune_multiply, best_strategy  # noqa: F401
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401

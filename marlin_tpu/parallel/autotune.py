@@ -6,7 +6,8 @@ MTUtils.scala:150-175), and ships ``RMMcompare`` (examples/RMMcompare.scala)
 so a human can time the candidates and pick by hand. This module makes that
 comparison programmatic: time each viable engine on the real operands ONCE
 per (shape, dtype, precision, mesh) configuration, cache the winner
-in-process, and let ``multiply(strategy="tuned")`` consult the cache — an
+in-process AND on disk (``config.autotune_cache_path``; winners survive
+process restarts), and let ``multiply(strategy="tuned")`` consult the cache — an
 empirical dispatch that beats any static heuristic wherever the heuristic's
 model of the machine is wrong (e.g. dispatch-latency-bound mid sizes, or
 meshes where resharding costs dominate).
@@ -19,6 +20,10 @@ enqueued back-to-back and forced once with a scalar fetch — the same
 
 from __future__ import annotations
 
+import json
+import os
+import tempfile
+import threading
 import time
 
 import jax.numpy as jnp
@@ -28,6 +33,92 @@ from .matmul import UnknownStrategyError
 __all__ = ["tune_multiply", "best_strategy", "clear_cache"]
 
 _CACHE: dict[tuple, str] = {}
+
+# Disk layer: tuned winners persist across process restarts (timing a full
+# candidate set costs seconds at production sizes — paying it once per
+# machine, not once per process, is the point). Keyed by the stringified
+# in-memory key, which carries shapes, both operands' layouts/specs, dtypes,
+# precision, mesh shape (device count), and backend platform — a cache entry
+# can never leak across a hardware or layout change. Entries are timings'
+# *winners* only; they are machine-specific by design, hence the local path.
+_DISK_LOCK = threading.Lock()
+_disk: dict[str, str] | None = None  # lazily loaded; path tracked for reloads
+_disk_path_loaded: str | None = None
+
+
+def _disk_path() -> str | None:
+    """Resolved persistence path; None when disabled (config path "")."""
+    from ..config import get_config
+
+    p = get_config().autotune_cache_path
+    if p == "":
+        return None
+    if p is None:
+        return os.path.join(os.path.expanduser("~"), ".cache", "marlin_tpu",
+                            "autotune.json")
+    return p
+
+
+def _disk_layer() -> dict[str, str]:
+    """The persisted winners, (re)loaded when first touched or when the
+    configured path changed. Unreadable/corrupt files degrade to empty —
+    autotune must never fail a multiply over a cache file."""
+    global _disk, _disk_path_loaded
+    path = _disk_path()
+    if path is None:
+        return {}
+    if _disk is None or _disk_path_loaded != path:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            _disk = {k: v for k, v in data.items() if isinstance(v, str)}
+        except (OSError, ValueError):
+            _disk = {}
+        _disk_path_loaded = path
+    return _disk
+
+
+def _persist(key: tuple, strategy: str) -> None:
+    """Merge one winner into the disk layer atomically (tmp + rename, the
+    io.checkpoint discipline — a torn write must not corrupt the cache).
+    Merge-on-write: the file is re-read under a lock before writing so
+    concurrent writers' freshly persisted winners are kept. Threads share
+    ``_DISK_LOCK``; concurrent *processes* are serialized by a best-effort
+    ``fcntl`` lock on a sidecar file (POSIX only — elsewhere a true
+    simultaneous cross-process race can still drop a key, costing one
+    re-tune on that process's next restart, never a corrupt file)."""
+    global _disk
+    path = _disk_path()
+    if path is None:
+        return
+    with _DISK_LOCK:
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        except OSError:
+            return  # read-only FS: in-process cache still works
+        lock_f = None
+        try:
+            try:
+                import fcntl
+
+                lock_f = open(path + ".lock", "w")
+                fcntl.flock(lock_f, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                lock_f = None  # non-POSIX / unlockable: best effort
+            _disk = None  # force a fresh read: pick up other processes' writes
+            layer = _disk_layer()
+            layer[repr(key)] = strategy
+            try:
+                fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                           suffix=".tmp")
+                with os.fdopen(fd, "w") as f:
+                    json.dump(layer, f, indent=1, sort_keys=True)
+                os.replace(tmp, path)
+            except OSError:
+                pass
+        finally:
+            if lock_f is not None:
+                lock_f.close()
 
 
 def _operand_meta(other):
@@ -125,17 +216,42 @@ def tune_multiply(mat, other, strategies=None, reps: int = 3,
         raise ValueError("no viable multiply strategy could be timed")
     results.sort(key=lambda kv: kv[1])
     if not explicit:
-        _CACHE[_cache_key(mat, other, precision)] = results[0][0]
+        key = _cache_key(mat, other, precision)
+        _CACHE[key] = results[0][0]
+        _persist(key, results[0][0])
     return results
 
 
 def best_strategy(mat, other, precision: str | None = None) -> str:
-    """Cached winner for this configuration — tunes on first sight."""
+    """Cached winner for this configuration — memory layer first, then the
+    on-disk layer (winners survive process restarts), tuning only on a miss
+    in both."""
+    from .matmul import _STRATEGIES
+
     key = _cache_key(mat, other, precision)
     if key not in _CACHE:
-        tune_multiply(mat, other, precision=precision)
+        with _DISK_LOCK:
+            persisted = _disk_layer().get(repr(key))
+        # validate against the live strategy set: a file written by an older
+        # version (renamed/removed engine) or hand-edited must degrade to a
+        # retune, never poison every tuned multiply of this configuration
+        if persisted in _STRATEGIES:
+            _CACHE[key] = persisted
+        else:
+            tune_multiply(mat, other, precision=precision)
     return _CACHE[key]
 
 
 def clear_cache() -> None:
+    """Clear BOTH layers: the in-process dict and the persisted file."""
+    global _disk, _disk_path_loaded
     _CACHE.clear()
+    with _DISK_LOCK:
+        _disk, _disk_path_loaded = None, None
+        path = _disk_path()
+        if path is not None:
+            for p in (path, path + ".lock"):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass

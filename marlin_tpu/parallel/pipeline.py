@@ -87,6 +87,15 @@ def pipeline_apply(stage_params, stage_fn, x, mesh: Mesh | None = None,
     microbatch per stage, the smallest count that fills the pipeline).
     Returns ``stage_{S-1}(... stage_0(x))`` with ``x``'s shape, replicated
     over the mesh. Differentiable end-to-end (scan-based schedule).
+
+    **Requirement on** ``stage_fn``: bubble ticks evaluate it on *all-zero*
+    activations (the branch-free schedule computes every tick and masks dead
+    results out of the primal), so ``stage_fn`` must produce finite outputs —
+    and finite VJPs — on zero-valued inputs. A stage that divides by a norm,
+    takes a log, or otherwise blows up at 0 yields inf/NaN whose backward
+    products can poison gradients even though the primal is masked (0 · NaN
+    is NaN). Guard such ops with an epsilon (the built-in LM blocks' rmsnorm
+    uses ``+ 1e-6``).
     """
     mesh = mesh or default_mesh()
     n_stages = mesh.shape[axis]

@@ -5,14 +5,21 @@ The reference spills oversized matrices via Spark's disk-backed RDDs
 spills via Spark; the rebuild needs host-offload streaming of blocks"). This
 module is that layer for the tall-skinny workloads (BASELINE.md config 4:
 10⁷×512 · 512×512): the tall operand lives on the host (numpy array, memmap, or
-a chunk generator), row-chunks are streamed through device HBM double-buffered
-(dispatch chunk i+1 before synchronizing chunk i), and either
+a chunk generator), row-chunks are streamed through device HBM, and either
 
 - :func:`streamed_matmul` — each chunk is multiplied against a resident
   (replicated/sharded) right-hand side and the result streams back to host, or
 - :func:`streamed_gramian` — AᵀA accumulates *on device* (the reference's
   Gramian aggregate, DenseVecMatrix.scala:1444-1486) and only the n×n result
   ever leaves.
+
+Chunk production (source read, dtype conversion, ``_compress_for_transfer``,
+H2D dispatch) runs on background threads through
+:class:`~marlin_tpu.parallel.prefetch.ChunkPrefetcher` by default
+(``config.prefetch_enabled``), so the upload of chunk i+1 overlaps device
+compute of chunk i instead of serializing behind it; ``prefetch=False`` (or
+the config flag) restores the synchronous loop. Results are bit-identical
+either way — the prefetcher reorders *work*, never *math*.
 """
 
 from __future__ import annotations
@@ -24,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import get_config
+from ..utils.profiling import StageTimes
+from .prefetch import ChunkPrefetcher
 
 __all__ = ["streamed_matmul", "streamed_gramian", "iter_row_chunks"]
 
@@ -40,6 +49,46 @@ def _as_chunks(a_source, chunk_rows: int) -> Iterable[np.ndarray]:
     return a_source  # already an iterable of chunks
 
 
+def _chunk_stream(a_source, chunk_rows: int, transfer_dtype, prefetch,
+                  stats: StageTimes):
+    """The shared front half of both streamed ops: an iterator of
+    device-committed chunks, prefetched on background threads when enabled.
+
+    Returns ``(iterator, closer)`` — ``closer()`` must run on every exit path
+    (the prefetcher owns threads)."""
+    chunks = _as_chunks(a_source, chunk_rows)
+
+    def transform(c):
+        # np.asarray first: list/sequence chunks become one array (device_put
+        # of a bare list would treat it as a pytree of scalars)
+        return _compress_for_transfer(np.asarray(c), transfer_dtype)
+
+    enabled = get_config().prefetch_enabled if prefetch is None else prefetch
+    if enabled:
+        pf = ChunkPrefetcher(chunks, transform, stats=stats)
+        return pf, pf.close
+    # synchronous fallback: same read + transform + upload, on the caller's
+    # thread ("produce" covers the source read too, matching the prefetcher's
+    # accounting so on/off stage breakdowns are comparable)
+    def sync_stream():
+        import time
+
+        it = iter(chunks)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                c = next(it)
+            except StopIteration:
+                return
+            c = transform(c)
+            stats.add("produce", time.perf_counter() - t0)
+            with stats.timed("transfer"):
+                c = jax.device_put(c)
+            yield c
+
+    return sync_stream(), (lambda: None)
+
+
 def streamed_matmul(
     a_source,
     b,
@@ -47,6 +96,8 @@ def streamed_matmul(
     out: np.ndarray | None = None,
     precision: str | None = None,
     transfer_dtype=None,
+    prefetch: bool | None = None,
+    stats: StageTimes | None = None,
 ) -> np.ndarray | None:
     """``A @ B`` where A streams through the device in row chunks.
 
@@ -56,8 +107,13 @@ def streamed_matmul(
     filled in place; otherwise chunks are collected and stacked (only sensible
     when the result fits host RAM).
     ``transfer_dtype="bfloat16"`` halves H2D bytes (host-side cast).
+    ``prefetch``: None = follow ``config.prefetch_enabled``; True/False force
+    the async pipeline on/off (results are identical either way).
+    ``stats``: optional :class:`StageTimes` receiving the per-stage
+    produce/transfer/stall/compute/drain breakdown.
     """
     precision = precision or get_config().matmul_precision
+    stats = stats if stats is not None else StageTimes()
     b_dev = jnp.asarray(b.logical() if hasattr(b, "logical") else b)
 
     @jax.jit
@@ -74,20 +130,27 @@ def streamed_matmul(
         nonlocal offset
         while len(pending) > limit:
             y = pending.pop(0)
-            y_np = np.asarray(jax.device_get(y))
+            with stats.timed("drain"):
+                y_np = np.asarray(jax.device_get(y))
             if out is not None:
                 out[offset : offset + y_np.shape[0]] = y_np
             else:
                 results.append(y_np)
             offset += y_np.shape[0]
 
-    for chunk in _as_chunks(a_source, chunk_rows):
-        saw_chunk = True
-        pending.append(chunk_mm(jnp.asarray(_compress_for_transfer(chunk, transfer_dtype))))
-        drain(1)  # keep one chunk in flight: overlap H2D/compute/D2H
-    if not saw_chunk:
-        raise ValueError("empty input stream")
-    drain(0)
+    stream, closer = _chunk_stream(a_source, chunk_rows, transfer_dtype,
+                                   prefetch, stats)
+    try:
+        for x in stream:
+            saw_chunk = True
+            with stats.timed("compute"):
+                pending.append(chunk_mm(x))
+            drain(1)  # keep one result in flight: overlap compute and D2H
+        if not saw_chunk:
+            raise ValueError("empty input stream")
+        drain(0)
+    finally:
+        closer()
     return out if out is not None else np.concatenate(results, axis=0)
 
 
@@ -115,14 +178,18 @@ def streamed_gramian(
     precision: str | None = None,
     dtype=jnp.float32,
     transfer_dtype=None,
+    prefetch: bool | None = None,
+    stats: StageTimes | None = None,
 ) -> np.ndarray:
     """``AᵀA`` with A streamed in row chunks and the n×n accumulator resident
     on device — one rank-chunk ``syrk`` per chunk, no driver reduction.
 
     ``transfer_dtype="bfloat16"`` casts chunks on the host before upload,
     halving H2D traffic (the streamed paths' bottleneck) at bf16 input
-    precision; accumulation stays in ``dtype`` (f32)."""
+    precision; accumulation stays in ``dtype`` (f32). ``prefetch``/``stats``
+    as in :func:`streamed_matmul`."""
     precision = precision or get_config().matmul_precision
+    stats = stats if stats is not None else StageTimes()
 
     @jax.jit
     def accumulate(g, x):
@@ -133,14 +200,20 @@ def streamed_gramian(
     # with no explicit transfer dtype, upload in the accumulation dtype (the
     # pre-existing contract: `dtype` governs both upload width and accumulator)
     effective_transfer = transfer_dtype if transfer_dtype is not None else dtype
-    for chunk in _as_chunks(a_source, chunk_rows):
-        x = jnp.asarray(_compress_for_transfer(chunk, effective_transfer))
-        if n_cols is not None and x.shape[1] != n_cols:
-            raise ValueError(f"chunk has {x.shape[1]} cols, expected {n_cols}")
-        if g is None:
-            n_cols = x.shape[1]
-            g = jnp.zeros((n_cols, n_cols), dtype)
-        g = accumulate(g, x)
+    stream, closer = _chunk_stream(a_source, chunk_rows, effective_transfer,
+                                   prefetch, stats)
+    try:
+        for x in stream:
+            if n_cols is not None and x.shape[1] != n_cols:
+                raise ValueError(f"chunk has {x.shape[1]} cols, expected {n_cols}")
+            if g is None:
+                n_cols = x.shape[1]
+                g = jnp.zeros((n_cols, n_cols), dtype)
+            with stats.timed("compute"):
+                g = accumulate(g, x)
+    finally:
+        closer()
     if g is None:
         raise ValueError("empty input stream")
-    return np.asarray(jax.device_get(g))
+    with stats.timed("drain"):
+        return np.asarray(jax.device_get(g))

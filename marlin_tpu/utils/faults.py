@@ -19,6 +19,10 @@ point               fires from
                     each step (raise/delay) and on each metric (mutation)
 ``device.probe``    each per-device probe in
                     :func:`~marlin_tpu.utils.failure.heartbeat`
+``prefetch.produce``
+                    :class:`~marlin_tpu.parallel.prefetch.ChunkPrefetcher`
+                    before each source-chunk read (ctx carries
+                    ``path="chunk-<i>"`` so ``match`` can target one chunk)
 ==================  =========================================================
 
 Behaviors are :class:`Fault` subclasses — :class:`RaiseFault` (raise once /
@@ -51,7 +55,7 @@ __all__ = [
 
 KNOWN_POINTS = frozenset({
     "ckpt.write", "ckpt.manifest", "fs.open", "fs.list", "step.run",
-    "device.probe",
+    "device.probe", "prefetch.produce",
 })
 
 

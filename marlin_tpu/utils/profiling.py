@@ -10,6 +10,10 @@ in examples and factorization loops plus ``MTUtils.evaluate`` to force lazy RDDs
 - :func:`timer` — wall-clock context manager that prints millis like the
   examples do (e.g. examples/BLAS3.scala:34-56).
 - :class:`StepTimer` — per-iteration timing hook for training loops.
+- :class:`StageTimes` — per-stage wall-clock aggregation for pipelined
+  operations (the streaming prefetch path's produce/transfer/compute/drain
+  split), thread-safe because producer threads and the consumer record into
+  the same instance.
 - :func:`trace` — context manager around ``jax.profiler`` emitting a TensorBoard
   trace (XLA-level, per-op on TPU); no reference equivalent.
 """
@@ -17,6 +21,7 @@ in examples and factorization loops plus ``MTUtils.evaluate`` to force lazy RDDs
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 import jax
@@ -78,6 +83,54 @@ class StepTimer:
             f"{len(self.times_ms)} steps, mean {self.mean_ms:.1f} ms, "
             f"min {min(self.times_ms):.1f} ms, max {max(self.times_ms):.1f} ms"
         )
+
+
+class StageTimes:
+    """Aggregate wall-clock by named stage across threads.
+
+    The streaming prefetch pipeline records ``produce`` (host read + dtype
+    conversion), ``transfer`` (``jax.device_put`` dispatch), ``stall`` (time
+    the consumer waited on the queue — the *un-overlapped* producer latency,
+    ~0 when prefetch is keeping up), ``compute`` (device dispatch) and
+    ``drain`` (blocking D2H fetches). Producer threads and the consumer write
+    concurrently, hence the lock."""
+
+    def __init__(self):
+        self.seconds: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def add(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            self.seconds[stage] = self.seconds.get(stage, 0.0) + seconds
+            self.counts[stage] = self.counts.get(stage, 0) + 1
+
+    @contextlib.contextmanager
+    def timed(self, stage: str):
+        t0 = time.perf_counter()
+        yield
+        self.add(stage, time.perf_counter() - t0)
+
+    def summary(self) -> str:
+        with self._lock:
+            if not self.seconds:
+                return "no stages recorded"
+            return ", ".join(
+                f"{k} {self.seconds[k]:.3f}s/{self.counts[k]}"
+                for k in sorted(self.seconds))
+
+    def emit(self, kind: str = "stage_times", log=None, **fields) -> None:
+        """Write one summary event to ``log`` (or the process-default
+        EventLog); silently no-ops when neither exists."""
+        from .tracing import get_default_event_log
+
+        log = log or get_default_event_log()
+        if log is None:
+            return
+        with self._lock:
+            secs = {f"{k}_s": round(v, 6) for k, v in self.seconds.items()}
+            counts = dict(self.counts)
+        log.event(kind, **secs, counts=counts, **fields)
 
 
 @contextlib.contextmanager

@@ -7,10 +7,33 @@ becomes NumPy goldens compared via ``to_numpy()`` against a NumPy oracle
 (their pattern: compute distributed, ``toBreeze()``, compare vs Breeze).
 """
 
+import threading
+import time
+
 import numpy as np
 import pytest
 
 import marlin_tpu as mt
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("marlin-prefetch")]
+
+
+@pytest.fixture(autouse=True)
+def _no_prefetch_thread_leaks():
+    """No prefetch worker may outlive its pipeline: ChunkPrefetcher joins its
+    threads on close/exhaustion, so a surviving marlin-prefetch-* thread after
+    a test is a leak in that test (or in the prefetcher itself). Mirrors the
+    fault-registry leak check below. A short grace window absorbs workers
+    mid-observation of the stop flag."""
+    yield
+    deadline = time.monotonic() + 2.0
+    while _prefetch_threads() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    leaked = _prefetch_threads()
+    assert not leaked, f"prefetch thread(s) leaked across tests: {leaked}"
 
 
 @pytest.fixture(autouse=True)

@@ -8,10 +8,13 @@ from marlin_tpu.parallel import autotune
 
 
 @pytest.fixture(autouse=True)
-def _fresh_cache():
-    autotune.clear_cache()
-    yield
-    autotune.clear_cache()
+def _fresh_cache(tmp_path):
+    # point the disk layer at a per-test path so clear_cache() (which clears
+    # BOTH layers) never touches a developer's real ~/.cache file
+    with mt.config_context(autotune_cache_path=str(tmp_path / "autotune.json")):
+        autotune.clear_cache()
+        yield
+        autotune.clear_cache()
 
 
 def test_tune_multiply_times_candidates(mesh):
@@ -92,6 +95,120 @@ def test_vector_operand_rejected_clearly(mesh):
     v = np.ones((32,), np.float32)
     with pytest.raises(ValueError, match="2-D right operand"):
         mt.tune_multiply(a, v)
+
+
+def _seed_cache_entry(mesh, strategy="gspmd", seed=40):
+    """A (key, operands) pair with the winner planted in both cache layers —
+    persistence tests must not depend on real multiplies succeeding."""
+    a = mt.DenseVecMatrix.random(seed, 32, 32, mesh=mesh)
+    b = mt.DenseVecMatrix.random(seed + 1, 32, 32, mesh=mesh)
+    key = autotune._cache_key(a, b, None)
+    autotune._CACHE[key] = strategy
+    autotune._persist(key, strategy)
+    return key, a, b
+
+
+def _simulate_restart():
+    autotune._CACHE.clear()
+    autotune._disk = None  # force a reload from the file
+
+
+def test_disk_cache_survives_restart(mesh, monkeypatch):
+    import os
+
+    key, a, b = _seed_cache_entry(mesh)
+    path = mt.get_config().autotune_cache_path
+    assert os.path.exists(path)
+    _simulate_restart()
+
+    def boom(*args, **kw):
+        raise AssertionError("re-tuned despite a persisted winner")
+
+    monkeypatch.setattr(autotune, "tune_multiply", boom)
+    assert autotune.best_strategy(a, b) == "gspmd"
+    assert len(autotune._CACHE) == 1  # promoted back into the memory layer
+
+
+def test_clear_cache_clears_both_layers(mesh):
+    import os
+
+    _seed_cache_entry(mesh)
+    path = mt.get_config().autotune_cache_path
+    assert os.path.exists(path)
+    autotune.clear_cache()
+    assert len(autotune._CACHE) == 0
+    assert not os.path.exists(path)
+
+
+def test_disk_layer_disabled_by_empty_path(mesh, tmp_path):
+    import os
+
+    with mt.config_context(autotune_cache_path=""):
+        key, a, b = _seed_cache_entry(mesh, seed=44)
+    assert not os.path.exists(os.path.join(str(tmp_path), "autotune.json"))
+
+
+def test_corrupt_disk_file_degrades_to_retune(mesh, monkeypatch):
+    key, a, b = _seed_cache_entry(mesh, seed=48)
+    path = mt.get_config().autotune_cache_path
+    with open(path, "w") as f:
+        f.write("{ not json")
+    _simulate_restart()
+    tuned = {"n": 0}
+
+    def fake_tune(mat, other, **kw):
+        tuned["n"] += 1
+        autotune._CACHE[autotune._cache_key(mat, other, None)] = "rmm"
+        return [("rmm", 0.001)]
+
+    monkeypatch.setattr(autotune, "tune_multiply", fake_tune)
+    # corrupt file must not crash; it just loses the persisted winners
+    assert autotune.best_strategy(a, b) == "rmm"
+    assert tuned["n"] == 1
+
+
+def test_stale_persisted_strategy_triggers_retune(mesh, monkeypatch):
+    """A winner persisted by an older version whose engine was renamed must
+    degrade to a retune, never poison every tuned multiply."""
+    key, a, b = _seed_cache_entry(mesh, seed=56, strategy="engine_v0_name")
+    _simulate_restart()
+    tuned = {"n": 0}
+
+    def fake_tune(mat, other, **kw):
+        tuned["n"] += 1
+        autotune._CACHE[autotune._cache_key(mat, other, None)] = "gspmd"
+        return [("gspmd", 0.001)]
+
+    monkeypatch.setattr(autotune, "tune_multiply", fake_tune)
+    assert autotune.best_strategy(a, b) == "gspmd"
+    assert tuned["n"] == 1  # the stale name was ignored
+
+
+def test_persist_merges_with_concurrent_writes(mesh):
+    """Merge-on-write: a winner another process wrote between our load and
+    our persist survives in the file (no lost update)."""
+    import json
+
+    key1, a, b = _seed_cache_entry(mesh, seed=60)
+    path = mt.get_config().autotune_cache_path
+    # simulate another process adding a winner behind our back
+    other = json.load(open(path))
+    other["('OtherProc', (1, 1))"] = "rmm"
+    json.dump(other, open(path, "w"))
+    key2 = autotune._cache_key(a, b, "highest")
+    autotune._persist(key2, "ring")
+    merged = json.load(open(path))
+    assert merged["('OtherProc', (1, 1))"] == "rmm"
+    assert merged[repr(key1)] == "gspmd"
+    assert merged[repr(key2)] == "ring"
+
+
+def test_disk_key_distinguishes_precision(mesh):
+    _, a, b = _seed_cache_entry(mesh, seed=52)
+    _simulate_restart()
+    key_high = autotune._cache_key(a, b, "highest")
+    with autotune._DISK_LOCK:
+        assert repr(key_high) not in autotune._disk_layer()
 
 
 def test_unknown_candidate_skipped_not_fatal(mesh):

@@ -27,6 +27,8 @@ the BASELINE config list:
   attn_long: pure causal flash attention at 256k+ tokens
        (MARLIN_BENCH_ATTN_SEQ scales it)
   decode: KV-cached autoregressive decode tokens/s (prefill vs per-token)
+  serve: continuous-batching engine offered-load sweep — p50/p99 latency and
+       tokens/s per offered rate (MARLIN_BENCH_SERVE_* env knobs scale it)
 """
 
 import json
@@ -593,6 +595,72 @@ def config_decode(d_model=512, heads=8, layers=4, vocab=4096,
                f"for the prompt; linear score memory (AOT-asserted)")
 
 
+def config_serve(d_model=64, heads=4, layers=2, vocab=256):
+    """Offered-load sweep through the serving engine (marlin_tpu/serving/):
+    submitters inject Poisson-ish open-loop traffic at each offered rate;
+    reported per rate are achieved tokens/s and p50/p99 end-to-end latency
+    (submit -> Result). Env control, MARLIN_BENCH_PREFETCH-style:
+    MARLIN_BENCH_SERVE_RATES (req/s list, default "4,16,64"),
+    MARLIN_BENCH_SERVE_N (requests per rate, default 64),
+    MARLIN_BENCH_SERVE_BATCH (slot width, default 8),
+    MARLIN_BENCH_SERVE_WARMUP=0 skips the per-bucket pre-compile (the
+    first-request-pays-the-compile A/B)."""
+    import jax  # noqa: F401  (backend init before threads)
+
+    import marlin_tpu as mt  # noqa: F401
+    from marlin_tpu.models import TransformerLM
+    from marlin_tpu.serving import Request, ServeEngine, percentile
+
+    rates = [float(r) for r in os.environ.get(
+        "MARLIN_BENCH_SERVE_RATES", "4,16,64").split(",")]
+    n_req = int(os.environ.get("MARLIN_BENCH_SERVE_N", 64))
+    max_batch = int(os.environ.get("MARLIN_BENCH_SERVE_BATCH", 8))
+    warmup = os.environ.get("MARLIN_BENCH_SERVE_WARMUP", "1") != "0"
+    buckets = ((64, 32), (256, 32))
+    lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
+                      layers=layers, seed=0)
+    params = lm.init_params()
+    rng = np.random.default_rng(0)
+
+    for rate in rates:
+        eng = ServeEngine(params, heads, buckets=buckets,
+                          max_batch=max_batch, max_wait_ms=5.0,
+                          queue_depth=4 * n_req)
+        try:
+            if warmup:
+                eng.warmup()
+            gaps = rng.exponential(1.0 / rate, n_req)
+            handles, t_start = [], time.perf_counter()
+            for i in range(n_req):
+                if i:  # inter-arrival gaps only BETWEEN submits: a trailing
+                    # sleep after the last one would deflate tok/s at low
+                    # rates (no request is outstanding during it)
+                    time.sleep(gaps[i - 1])
+                plen = int(rng.integers(8, 192))
+                handles.append(eng.submit(Request(
+                    prompt=rng.integers(0, vocab, plen).astype(np.int32),
+                    steps=32)))
+            eng.drain()
+            span = time.perf_counter() - t_start
+        finally:
+            eng.close()
+        results = [h.result(timeout=0) for h in handles]
+        ok = [r for r in results if r.ok]
+        lat = [r.metrics["total_s"] for r in ok]
+        snap = eng.metrics.snapshot()
+        toks = sum(r.tokens.size - len(h.request.prompt)
+                   for h, r in zip(handles, results) if r.ok)
+        # a fully-shed load point (admission rejecting everything, chaos
+        # faults) is a degraded data point, not a sweep abort
+        p50 = f"{percentile(lat, 50) * 1e3:.0f}" if lat else "n/a"
+        p99 = f"{percentile(lat, 99) * 1e3:.0f}" if lat else "n/a"
+        record(f"serve_load{rate:g}", toks / span, "tok/s",
+               f"{len(ok)}/{n_req} ok at {rate:g} req/s offered; p50 "
+               f"{p50} ms / p99 {p99} ms latency; occupancy "
+               f"{snap['occupancy_mean']}, {snap['batches']} batches, "
+               f"warmup={'on' if warmup else 'off'}")
+
+
 def config_svd(m=1_000_000, n=512, k=8):
     """Top-k SVD of a tall-skinny matrix via the distributed Gramian +
     matrix-free Lanczos path (the reference's dist-eigs ARPACK mode,
@@ -718,6 +786,7 @@ def main():
         "attn_long": config_attn_long,
         "decode": config_decode,
         "moe": config_moe,
+        "serve": config_serve,
     }
     for k in which:
         log(f"=== config {k}")

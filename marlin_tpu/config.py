@@ -86,6 +86,22 @@ class MarlinConfig:
     # producer blocks before device_put when the budget is full (at least one
     # chunk is always allowed through). 0 = unbounded (depth alone bounds it).
     prefetch_hbm_budget_bytes: int = 2 << 30
+    # --- serving engine (serving/) -------------------------------------------
+    # Slot rows per dispatched batch. Every batch is padded to exactly this
+    # width (free slots carry dummy rows), so the compiled program count is
+    # bounded by the bucket set, not the traffic pattern.
+    serve_max_batch: int = 8
+    # A partial batch dispatches once its oldest request has waited this long
+    # (ms, on the engine's injectable clock); a full batch dispatches
+    # immediately. 0 = dispatch as soon as anything is pending.
+    serve_max_wait_ms: float = 10.0
+    # Admission bound on requests pending-or-in-flight; submissions beyond it
+    # are rejected with a reason (backpressure, never blocking the caller).
+    serve_queue_depth: int = 256
+    # The static (padded_prompt, decode_steps) shape set. Each bucket costs
+    # one compile per sampling variant; prompts/steps round UP to the
+    # smallest fitting bucket (docs/serving.md has tuning guidance).
+    serve_buckets: tuple = ((64, 32), (256, 64))
     # --- autotune persistence (parallel/autotune.py) -------------------------
     # Where the empirical multiply-strategy winners persist across processes.
     # None = ~/.cache/marlin_tpu/autotune.json; "" disables the disk layer

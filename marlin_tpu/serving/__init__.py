@@ -1,0 +1,40 @@
+"""Continuous-batching inference serving (docs/serving.md).
+
+The ROADMAP north star is "heavy traffic from millions of users"; the
+reference delegated all request scheduling to Spark (SURVEY.md §0). This
+package is the TPU-native replacement front half: admission control
+(request.py), shape bucketing + dynamic batch formation (batcher.py), the
+worker-loop engine with a drain-safe lifecycle (engine.py), and serving
+observability through the EventLog (metrics.py).
+
+Quick start::
+
+    from marlin_tpu.serving import Request, ServeEngine
+
+    with ServeEngine(params, heads=lm.heads) as eng:
+        eng.warmup()                              # compile once per bucket
+        h = eng.submit(Request(prompt=[1, 2, 3], steps=16))
+        tokens = h.result(timeout=60).tokens
+"""
+
+from .batcher import (  # noqa: F401
+    BatchFormer,
+    aot_compile_buckets,
+    bucket_kv_bytes,
+    normalize_buckets,
+    pick_bucket,
+    warmup_buckets,
+)
+from .engine import ServeEngine  # noqa: F401
+from .metrics import ServeMetrics, percentile  # noqa: F401
+from .request import (  # noqa: F401
+    STATUS_ERROR,
+    STATUS_EXPIRED,
+    STATUS_OK,
+    STATUS_REJECTED,
+    STATUS_SHUTTING_DOWN,
+    AdmissionQueue,
+    Request,
+    Result,
+    ResultHandle,
+)

@@ -1,0 +1,270 @@
+"""Shape bucketing and dynamic batch formation for the serving engine.
+
+``_lm_generate_batch_jit`` compiles one XLA program per *shape* — batch B,
+padded prompt P, decode steps S are all baked into the executable. Serving
+traffic is ragged, so without discipline every new (B, P, S) triple pays a
+fresh multi-second compile. The discipline here:
+
+- **Buckets** — a small static set of ``(P_bucket, steps_bucket)`` pairs. A
+  request pads its prompt up to the smallest fitting ``P_bucket`` and rounds
+  its steps up to that bucket's ``steps_bucket`` (the result is sliced back
+  to the requested length).
+- **Fixed batch width** — every dispatched batch is padded to exactly
+  ``max_batch`` rows (free rows carry an inert 1-token dummy prompt), so B
+  never varies and the compile count is bounded by the bucket count, not the
+  traffic pattern.
+- **Dynamic forming** — :class:`BatchFormer` groups admitted requests by
+  (bucket, sampling knobs) and closes a group's batch when it reaches
+  ``max_batch`` rows or its oldest request has waited ``max_wait`` seconds,
+  whichever first. The clock is injectable, so tests drive the wait logic
+  deterministically.
+- **Warmup** — :func:`warmup_buckets` runs one dummy full-width batch per
+  bucket so the per-bucket compile happens before traffic (the engine
+  exposes it as ``ServeEngine.warmup()``); :func:`aot_compile_buckets`
+  compiles the same programs against a compile-only TPU topology
+  (:mod:`marlin_tpu.utils.aot` — no chip needed) and returns the compiler's
+  per-bucket peak-HBM accounting, the offline sizing channel for
+  ``serve_buckets`` / ``serve_max_batch``.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Sequence
+
+import numpy as np
+
+__all__ = ["normalize_buckets", "pick_bucket", "bucket_kv_bytes",
+           "BatchFormer", "warmup_buckets", "aot_compile_buckets"]
+
+Bucket = tuple[int, int]  # (P_bucket, steps_bucket)
+
+
+def normalize_buckets(buckets: Iterable[Sequence[int]]) -> tuple[Bucket, ...]:
+    """Validate and sort a bucket set ascending by (P, steps) — the order
+    :func:`pick_bucket` scans, so "smallest fitting bucket" is first hit."""
+    out = []
+    for b in buckets:
+        p, s = int(b[0]), int(b[1])
+        if p < 1 or s < 1:
+            raise ValueError(f"bucket dims must be >= 1, got {(p, s)}")
+        out.append((p, s))
+    if not out:
+        raise ValueError("at least one (P, steps) bucket is required")
+    if len(set(out)) != len(out):
+        raise ValueError(f"duplicate buckets in {out}")
+    return tuple(sorted(out))
+
+
+def pick_bucket(prompt_len: int, steps: int,
+                buckets: Sequence[Bucket]) -> Bucket | None:
+    """The smallest bucket holding a ``prompt_len``-token prompt generating
+    ``steps`` tokens, or None when nothing fits (an admission rejection —
+    better than a surprise compile)."""
+    for p, s in buckets:
+        if prompt_len <= p and steps <= s:
+            return (p, s)
+    return None
+
+
+def bucket_kv_bytes(params: dict, heads: int, bucket: Bucket,
+                    compute_dtype=None, batch: int = 1) -> int:
+    """Per-request KV-cache bytes for one bucket row (times ``batch``): the
+    decode working set is layers x 2 x max_len x kv_heads x dh in the compute
+    dtype, and max_len = P + steps. This is the admission-control cost model
+    — the cache IS the decode memory (models/transformer.py), so bounding the
+    summed row cost bounds what a burst of admissions can pin in HBM."""
+    import jax.numpy as jnp
+
+    from ..models.transformer import _n_layers
+
+    p, s = bucket
+    d = params["emb"].shape[1]
+    dh = d // heads
+    kv_dim = params["l0"]["wk"].shape[1]  # kv_heads * dh (GQA-aware)
+    dt = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
+    return _n_layers(params) * 2 * (p + s) * (kv_dim // dh) * dh \
+        * dt.itemsize * batch
+
+
+class _Group:
+    """One (bucket, sampling-signature) stream of pending entries, kept in
+    dispatch order: higher priority first, FIFO among equals (stable sort on
+    a monotonic sequence number keeps arrival order)."""
+
+    def __init__(self):
+        self.entries: list = []  # (-priority, seq, entry)
+
+    def add(self, entry, seq: int) -> None:
+        self.entries.append((-entry.request.priority, seq, entry))
+        self.entries.sort(key=lambda t: t[:2])
+
+    def oldest_t(self) -> float:
+        """Earliest enqueue time among pending entries (groups are at most
+        ~max_batch long, so the scan is trivial)."""
+        return min(e.enq_t for _, _, e in self.entries)
+
+    def take(self, n: int):
+        taken = [e for _, _, e in self.entries[:n]]
+        del self.entries[:n]
+        return taken
+
+
+class BatchFormer:
+    """Groups pending entries by (bucket, temperature, top_p, top_k) and
+    decides when a batch closes. Not thread-safe by itself — the engine calls
+    it under its own condition lock (one mutator, one reader)."""
+
+    def __init__(self, buckets: Sequence[Bucket], max_batch: int,
+                 max_wait: float):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_wait < 0:
+            raise ValueError(f"max_wait must be >= 0, got {max_wait}")
+        self.buckets = normalize_buckets(buckets)
+        self.max_batch = max_batch
+        self.max_wait = max_wait
+        self._groups: dict[tuple, _Group] = collections.defaultdict(_Group)
+        self._seq = 0
+
+    def add(self, entry) -> None:
+        """File one admitted entry under its (bucket, sampling) group.
+        ``entry.bucket`` and ``entry.enq_t`` were set at admission
+        (engine.submit). Sampled requests (temperature > 0) additionally
+        group by seed — the whole batch decodes under ONE PRNG key, so a
+        different-seed co-tenant would silently get its neighbor's stream;
+        greedy requests ignore the key, so seed never fragments their
+        batches."""
+        r = entry.request
+        seed = r.seed if r.temperature > 0 else None
+        key = (entry.bucket, float(r.temperature), r.top_p, r.top_k, seed)
+        self._groups[key].add(entry, self._seq)
+        self._seq += 1
+
+    def pending(self) -> int:
+        return sum(len(g.entries) for g in self._groups.values())
+
+    def next_batch(self, now: float, force: bool = False):
+        """``(group_key, entries)`` for the batch to dispatch now, else
+        ``(None, wait_hint)`` — ``wait_hint`` the seconds (on the injected
+        clock) until the oldest partial batch hits ``max_wait`` (``None``
+        when nothing is pending). Full groups dispatch immediately; among
+        ripe partial groups the longest-waiting dispatches first. ``force``
+        treats every non-empty group as ripe — the drain path, where waiting
+        out ``max_wait`` for stragglers that can never arrive is pointless."""
+        ripe, ripe_t, hint = None, None, None
+        for key, g in self._groups.items():
+            if not g.entries:
+                continue
+            if len(g.entries) >= self.max_batch:
+                return key, g.take(self.max_batch)
+            oldest = g.oldest_t()
+            waited = now - oldest
+            if force or waited >= self.max_wait:
+                if ripe is None or oldest < ripe_t:
+                    ripe, ripe_t = key, oldest
+            else:
+                left = self.max_wait - waited
+                hint = left if hint is None else min(hint, left)
+        if ripe is not None:
+            return ripe, self._groups[ripe].take(self.max_batch)
+        return None, hint
+
+    def take_all(self) -> list:
+        """Drain every pending entry (close() path — they get ShuttingDown
+        results, never a decode)."""
+        out = []
+        for g in self._groups.values():
+            out.extend(g.take(len(g.entries)))
+        return out
+
+
+def _dummy_batch(bucket: Bucket, batch: int):
+    """An inert full-width batch for a bucket: 1-token rows of token 0."""
+    p, s = bucket
+    prompts = np.zeros((batch, p), np.int32)
+    lengths = np.ones((batch,), np.int32)
+    return prompts, lengths
+
+
+def warmup_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
+                   max_batch: int, compute_dtype: str | None = None,
+                   moe: tuple | None = None) -> int:
+    """Compile (and execute once, on dummy rows) the full-width batch program
+    of every bucket, so the first real request never pays the compile.
+    Returns the number of buckets warmed. Greedy, top_p/top_k off — the
+    default-sampling program; a float top_p or a top_k adds its own variant
+    on first use (docs/serving.md)."""
+    import jax
+
+    from ..models.transformer import lm_generate_batch
+
+    buckets = normalize_buckets(buckets)
+    for bucket in buckets:
+        p, s = bucket
+        prompts, lengths = _dummy_batch(bucket, max_batch)
+        out = lm_generate_batch(params, prompts, lengths, jax.random.key(0),
+                                heads=heads, max_len=p + s, steps=s,
+                                compute_dtype=compute_dtype, moe=moe)
+        jax.block_until_ready(out)
+    return len(buckets)
+
+
+def _peak_bytes(ma) -> int:
+    """Peak device bytes from a ``memory_analysis()`` result. Some PJRT
+    builds expose ``peak_memory_in_bytes``; where the stats object lacks it
+    (the repo's getattr-guarded jaxlib-variance convention), fall back to
+    the documented lower bound temp + argument + output bytes."""
+    peak = getattr(ma, "peak_memory_in_bytes", None)
+    if peak is not None:
+        return int(peak)
+    return int(ma.temp_size_in_bytes + ma.argument_size_in_bytes
+               + ma.output_size_in_bytes)
+
+
+def aot_compile_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
+                        max_batch: int, compute_dtype: str | None = None,
+                        moe: tuple | None = None,
+                        topology_name: str = "v5e:2x2") -> dict[Bucket, int]:
+    """Compile every bucket's batch program against a compile-only TPU
+    topology (no chip; :mod:`marlin_tpu.utils.aot`) and return
+    ``{bucket: peak_hbm_bytes}`` from the compiler's own accounting — the
+    offline evidence for sizing ``serve_buckets`` x ``serve_max_batch``
+    against :func:`~marlin_tpu.models.planner.usable_hbm_bytes` (the same
+    budget the admission gate enforces at runtime). Requires libtpu
+    (:func:`~marlin_tpu.utils.aot.supports_aot_tpu`). Peak accounting
+    degrades to the temp+argument+output lower bound on PJRT builds whose
+    stats object lacks ``peak_memory_in_bytes`` (:func:`_peak_bytes`)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..config import config_context
+    from ..models.transformer import _lm_generate_batch_jit
+    from ..utils.aot import topology_mesh
+
+    mesh = topology_mesh(("rows",), (1,), topology_name=topology_name)
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    def sds(tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
+                                           sharding=rep), tree)
+
+    out = {}
+    for bucket in normalize_buckets(buckets):
+        p, s = bucket
+        args = (sds(params),
+                jax.ShapeDtypeStruct((max_batch, p), jnp.int32, sharding=rep),
+                jax.ShapeDtypeStruct((max_batch,), jnp.int32, sharding=rep),
+                sds(jax.eval_shape(jax.random.key, 0)),
+                jax.ShapeDtypeStruct((), jnp.float32, sharding=rep),
+                jax.ShapeDtypeStruct((), jnp.float32, sharding=rep))
+        with config_context(pallas_interpret=False):
+            compiled = _lm_generate_batch_jit.trace(
+                *args[:4], heads=heads, max_len=p + s, steps=s,
+                temperature=args[4], compute_dtype=compute_dtype,
+                top_p=args[5], use_top_p=False, top_k=None,
+                moe=moe).lower().compile()
+        out[bucket] = _peak_bytes(compiled.memory_analysis())
+    return out

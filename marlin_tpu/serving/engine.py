@@ -1,0 +1,363 @@
+"""The continuous-batching serving engine: one worker thread, compiled decode.
+
+:class:`ServeEngine` is the front half of an inference stack over the
+library's batched decode (:func:`~marlin_tpu.models.transformer
+.lm_generate_batch`, "the serving shape"): concurrent callers ``submit``
+requests; an admission gate (queue depth + in-flight KV-cache HBM budget,
+request.py) rejects overload with a reason; a batch former (batcher.py)
+buckets prompts onto a small static shape set so each bucket compiles ONCE;
+and a single worker thread runs the continuous loop —
+
+    claim a batch of slots  →  retire deadline-expired rows  →  prefill the
+    live rows + run the bucket's compiled decode program (one fused XLA
+    program per bucket)  →  retire finished rows with Results  →  repeat
+
+Scheduling is gang-style: the ``max_batch`` slot rows of one bucket launch
+and land together (free slots carry inert dummy rows so the batch shape —
+and therefore the compiled program — never varies). That trades some
+tail-row latency for two hard guarantees the acceptance tests assert: a
+bounded compile count (≤ one program per bucket for default sampling) and
+bit-identical outputs to calling ``lm_generate_batch`` directly on the same
+bucket shape. Row-level continuous batching (admitting into a running
+batch's free slots mid-decode) is the documented next step
+(docs/serving.md).
+
+Lifecycle: ``drain()`` stops admission and completes everything already
+accepted (partial batches dispatch immediately rather than waiting out
+``max_wait``); ``close()`` stops admission, finishes the batch in flight,
+and retires everything still queued with a clean ``shutting_down`` Result.
+Both are terminal and idempotent; the worker thread (named
+``marlin-serve-*`` — the conftest leak fixture watches the prefix) is joined
+before either returns. Chaos hooks: ``serve.enqueue`` fires in ``submit``,
+``serve.step`` fires before each batch launch (utils/faults.py) — a fault
+there fails that batch's requests with ``error`` Results and the engine
+keeps serving.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+import numpy as np
+
+from ..config import get_config
+from ..utils import faults
+from .batcher import (BatchFormer, bucket_kv_bytes, normalize_buckets,
+                      pick_bucket, warmup_buckets)
+from .metrics import ServeMetrics
+from .request import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK,
+                      STATUS_REJECTED, STATUS_SHUTTING_DOWN, AdmissionQueue,
+                      Request, Result, ResultHandle)
+
+__all__ = ["ServeEngine"]
+
+_engine_ids = itertools.count()
+
+# real-seconds cap on one condition wait under an INJECTED clock: bounds how
+# stale the worker's view of a fake clock can get (tests advance it between
+# polls). Real-clock engines never poll — they wait on the condition until
+# notified or the exact max_wait hint elapses.
+_POLL_CAP_S = 0.02
+
+
+class _Entry:
+    """One admitted request riding through the former to a batch slot."""
+
+    __slots__ = ("request", "handle", "bucket", "cost", "enq_t")
+
+    def __init__(self, request, handle, bucket, cost, enq_t):
+        self.request = request
+        self.handle = handle
+        self.bucket = bucket
+        self.cost = cost
+        self.enq_t = enq_t
+
+
+class ServeEngine:
+    """Continuous-batching inference engine over a trained LM.
+
+    ``params``/``heads``/``compute_dtype``/``moe`` describe the model exactly
+    as :func:`lm_generate_batch` takes them. Knobs default from the global
+    config: ``buckets`` (``serve_buckets``), ``max_batch``
+    (``serve_max_batch``), ``max_wait_ms`` (``serve_max_wait_ms``),
+    ``queue_depth`` (``serve_queue_depth``); ``hbm_budget_bytes`` defaults to
+    the planner's :func:`~marlin_tpu.models.planner.usable_hbm_bytes` (0
+    disables the byte gate). ``clock`` is the engine's *policy* clock
+    (deadlines, max_wait, latency metrics) — injectable for deterministic
+    tests; wall throughput is always measured on the real clock. ``log``
+    overrides the default EventLog for ``serve`` records.
+
+    Usable as a context manager (``close()`` on exit); ``start=False`` defers
+    the worker thread so tests can stage a queue before any dispatch."""
+
+    def __init__(self, params: dict, heads: int, *, buckets=None,
+                 max_batch: int | None = None,
+                 max_wait_ms: float | None = None,
+                 queue_depth: int | None = None,
+                 hbm_budget_bytes: int | None = None,
+                 compute_dtype: str | None = None, moe: tuple | None = None,
+                 clock=time.monotonic, log=None, start: bool = True):
+        cfg = get_config()
+        self.params = params
+        self.heads = heads
+        self.compute_dtype = compute_dtype
+        self.moe = moe
+        self.buckets = normalize_buckets(
+            cfg.serve_buckets if buckets is None else buckets)
+        self.max_batch = int(cfg.serve_max_batch if max_batch is None
+                             else max_batch)
+        wait_ms = cfg.serve_max_wait_ms if max_wait_ms is None else max_wait_ms
+        depth = int(cfg.serve_queue_depth if queue_depth is None
+                    else queue_depth)
+        if hbm_budget_bytes is None:
+            from ..models.planner import usable_hbm_bytes
+
+            hbm_budget_bytes = usable_hbm_bytes()
+        self._clock = clock
+        self._real_clock = clock is time.monotonic
+        self.metrics = ServeMetrics(log=log)
+        self._queue = AdmissionQueue(depth, hbm_budget_bytes)
+        self._cond = threading.Condition()
+        self._former = BatchFormer(self.buckets, self.max_batch,
+                                   max_wait=float(wait_ms) / 1e3)
+        self._state = "running"  # running | draining | closing | closed
+        self._started = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"marlin-serve-{next(_engine_ids)}")
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Start the worker thread (idempotent; no-op once shutting down)."""
+        with self._cond:
+            if self._started or self._state != "running":
+                return
+            self._started = True
+        self._thread.start()
+
+    def warmup(self) -> int:
+        """Compile every bucket's full-width batch program before traffic
+        (one dummy execution per bucket; see batcher.warmup_buckets)."""
+        return warmup_buckets(self.params, self.heads, self.buckets,
+                              self.max_batch, self.compute_dtype, self.moe)
+
+    def pending(self) -> int:
+        """Requests admitted but not yet retired (queued + in flight)."""
+        return self._queue.count
+
+    def drain(self) -> None:
+        """Graceful stop: no new admissions (rejections say "draining"), but
+        everything already accepted — queued and in flight — completes.
+        Partial batches dispatch immediately. Terminal: the worker exits and
+        is joined before this returns."""
+        self._queue.close("engine draining (no new admissions)")
+        self.start()  # a never-started engine still owes queued results
+        with self._cond:
+            if self._state == "running":
+                self._state = "draining"
+            self._cond.notify_all()
+        if self._started:
+            self._thread.join()
+        with self._cond:
+            self._state = "closed"
+
+    def close(self) -> None:
+        """Fast stop: no new admissions, the batch in flight completes, and
+        every still-queued request is retired with a clean
+        ``shutting_down`` Result (never silently dropped). Idempotent."""
+        self._queue.close("engine shutting down")
+        with self._cond:
+            if self._state == "closed":
+                return
+            self._state = "closing"
+            leftovers = self._former.take_all()
+            self._cond.notify_all()
+        for e in leftovers:
+            self._retire(e, Result(
+                e.request.rid, STATUS_SHUTTING_DOWN,
+                reason="engine closed before this request was scheduled"))
+        if self._started:
+            self._thread.join()
+        with self._cond:
+            self._state = "closed"
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------- admission
+
+    def submit(self, request: Request) -> ResultHandle:
+        """Admit one request. Always returns a handle that will carry exactly
+        one Result; overload / no-bucket / past-deadline submissions resolve
+        immediately with ``rejected`` / ``expired`` status and a reason."""
+        faults.fire("serve.enqueue", path=str(request.rid))
+        handle = ResultHandle(request)
+        now = self._clock()
+        bucket = pick_bucket(request.prompt.shape[0], request.steps,
+                             self.buckets)
+        if bucket is None:
+            return self._refuse(handle, STATUS_REJECTED, (
+                f"no bucket fits prompt_len={request.prompt.shape[0]} "
+                f"steps={request.steps} (buckets {list(self.buckets)})"))
+        if request.deadline is not None and request.deadline <= now:
+            return self._refuse(handle, STATUS_EXPIRED, (
+                f"deadline {request.deadline} already passed at submission "
+                f"(now {now})"))
+        cost = bucket_kv_bytes(self.params, self.heads, bucket,
+                               self.compute_dtype)
+        reason = self._queue.try_admit(cost)
+        if reason is not None:
+            return self._refuse(handle, STATUS_REJECTED, reason)
+        entry = _Entry(request, handle, bucket, cost, now)
+        with self._cond:
+            if self._state != "running":
+                admitted = False
+            else:
+                self._former.add(entry)
+                self._cond.notify_all()
+                admitted = True
+        if not admitted:  # raced with close(): resolve, don't strand
+            self._queue.release(cost)
+            return self._refuse(handle, STATUS_REJECTED,
+                                "engine is shutting down")
+        self.metrics.record_enqueue(request.rid, bucket, self._queue.count)
+        return handle
+
+    def submit_many(self, requests) -> list[ResultHandle]:
+        return [self.submit(r) for r in requests]
+
+    def _refuse(self, handle, status: str, reason: str) -> ResultHandle:
+        handle._set(Result(handle.request.rid, status, reason=reason))
+        if status == STATUS_REJECTED:
+            self.metrics.record_reject(handle.request.rid, reason)
+        else:
+            self.metrics.record_result(handle.request.rid, status)
+        return handle
+
+    # ----------------------------------------------------------- worker loop
+
+    def _run(self) -> None:
+        inflight = []
+        try:
+            while True:
+                batch = None
+                with self._cond:
+                    while True:
+                        if self._state == "closing":
+                            return
+                        draining = self._state == "draining"
+                        batch = self._former.next_batch(self._clock(),
+                                                        force=draining)
+                        if batch[0] is not None:
+                            break
+                        if draining:
+                            return  # nothing pending; in-flight is us
+                        hint = batch[1]
+                        if self._real_clock:
+                            # submit/drain/close all notify — idle waits
+                            # need no polling on the real clock
+                            self._cond.wait(hint)
+                        else:
+                            # injected clock: cap the real wait so advances
+                            # between polls are observed promptly
+                            self._cond.wait(
+                                _POLL_CAP_S if hint is None
+                                else min(max(hint, 1e-4), _POLL_CAP_S))
+                inflight = batch[1]
+                self._execute(*batch)
+                inflight = []
+        except BaseException:  # pragma: no cover - scheduler invariant
+            # a dying worker must not strand submitters on .result(): fail
+            # the batch it was holding plus everything still queued, then
+            # re-raise for the thread log (_execute absorbs ordinary
+            # Exceptions itself; this path is KeyboardInterrupt-class)
+            with self._cond:
+                leftovers = self._former.take_all()
+                self._state = "closing"
+            for e in leftovers + [e for e in inflight
+                                  if not e.handle.done()]:
+                self._retire(e, Result(e.request.rid, STATUS_ERROR,
+                                       reason="serving worker died"))
+            raise
+
+    def _retire(self, entry: _Entry, result: Result) -> None:
+        entry.handle._set(result)
+        self._queue.release(entry.cost)
+        self.metrics.record_result(
+            result.rid, result.status, bucket=result.metrics.get("bucket"),
+            queue_s=result.metrics.get("queue_s"),
+            total_s=result.metrics.get("total_s"))
+
+    def _execute(self, group_key, entries) -> None:
+        """One engine cycle: expire stale rows, prefill live rows into the
+        bucket's fixed-width slot batch, run the compiled program, retire."""
+        import jax
+
+        from ..models.transformer import lm_generate_batch
+
+        bucket, temperature, top_p, top_k, _ = group_key
+        # sampled groups share one seed (the former keys on it); greedy
+        # groups ignore the key entirely, so any member's seed serves
+        p, s = bucket
+        dispatch_t = self._clock()
+        live = []
+        for e in entries:
+            dl = e.request.deadline
+            if dl is not None and dl <= dispatch_t:
+                self._retire(e, Result(
+                    e.request.rid, STATUS_EXPIRED,
+                    reason=f"deadline {dl} passed before dispatch "
+                           f"(dispatched at {dispatch_t})",
+                    metrics={"bucket": bucket,
+                             "queue_s": dispatch_t - e.enq_t,
+                             "total_s": dispatch_t - e.enq_t}))
+            else:
+                live.append(e)
+        if not live:
+            return
+        try:
+            faults.fire("serve.step", path=f"bucket-{p}x{s}")
+            # prefill the claimed slots; free slots carry inert dummy rows so
+            # the batch shape (and the compiled program) never varies
+            prompts = np.zeros((self.max_batch, p), np.int32)
+            lengths = np.ones((self.max_batch,), np.int32)
+            for i, e in enumerate(live):
+                n = e.request.prompt.shape[0]
+                prompts[i, :n] = e.request.prompt
+                lengths[i] = n
+            key = jax.random.key(live[0].request.seed)
+            t0 = time.perf_counter()
+            out = np.asarray(lm_generate_batch(
+                self.params, prompts, lengths, key, heads=self.heads,
+                max_len=p + s, steps=s, temperature=temperature, top_p=top_p,
+                top_k=top_k, compute_dtype=self.compute_dtype, moe=self.moe))
+            wall = time.perf_counter() - t0
+        except Exception as exc:
+            reason = f"batch failed: {type(exc).__name__}: {exc}"
+            done_t = self._clock()
+            for e in live:
+                self._retire(e, Result(
+                    e.request.rid, STATUS_ERROR, reason=reason,
+                    metrics={"bucket": bucket,
+                             "queue_s": dispatch_t - e.enq_t,
+                             "total_s": done_t - e.enq_t}))
+            return
+        done_t = self._clock()
+        for i, e in enumerate(live):
+            n = e.request.prompt.shape[0]
+            self._retire(e, Result(
+                e.request.rid, STATUS_OK,
+                tokens=out[i, : n + e.request.steps].copy(),
+                metrics={"bucket": bucket, "queue_s": dispatch_t - e.enq_t,
+                         "ttft_s": done_t - e.enq_t,
+                         "total_s": done_t - e.enq_t}))
+        self.metrics.record_batch(bucket, len(live), self.max_batch,
+                                  len(live) * s, wall)

@@ -1,0 +1,145 @@
+"""Serving observability: counters + per-request latencies through EventLog.
+
+Every record goes to the engine's :class:`~marlin_tpu.utils.tracing.EventLog`
+(or the process default, resolved per emit so a log installed mid-run is
+picked up) under the single kind ``"serve"`` with an ``ev`` discriminator:
+
+=============  ===========================================================
+``ev``         fields
+=============  ===========================================================
+``enqueue``    ``rid``, ``bucket``, ``depth`` (queue depth after admit)
+``reject``     ``rid``, ``reason``
+``batch``      ``bucket``, ``rows`` (live), ``occupancy`` (live/max_batch),
+               ``new_tokens``, ``seconds`` (wall), ``tok_s``
+``result``     ``rid``, ``status``, ``bucket``, ``queue_s``, ``ttft_s``,
+               ``total_s``
+=============  ===========================================================
+
+Latencies are measured on the engine's *injected* clock (deterministic
+tests), throughput (``tok_s``) on the real wall clock (it is a measurement,
+not a policy input). Under the engine's gang scheduling a row's first token
+becomes visible only when its batch's whole generation program returns, so
+``ttft_s`` equals ``total_s`` today; both are recorded so the contract is
+stable when a streaming decode loop lands (docs/serving.md).
+
+:meth:`ServeMetrics.snapshot` aggregates everything for tests and the bench
+(`bench_all.py serve`) without re-reading the log file.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+from ..utils.tracing import get_default_event_log
+
+__all__ = ["ServeMetrics", "percentile"]
+
+
+def percentile(values, q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) of a non-empty list — tiny and
+    dependency-free so the bench and tests share one definition."""
+    xs = sorted(values)
+    if not xs:
+        raise ValueError("percentile of empty list")
+    i = max(0, min(len(xs) - 1, math.ceil(q / 100.0 * len(xs)) - 1))
+    return xs[i]
+
+
+class ServeMetrics:
+    """Thread-safe counter/latency sink for one engine. All record_* methods
+    are called by the engine (submit path + worker thread) — never raise out
+    of them into the serving path."""
+
+    def __init__(self, log=None, keep_latencies: int = 4096):
+        self._log = log
+        self._lock = threading.Lock()
+        self._keep = keep_latencies
+        self.submitted = 0
+        self.rejected = 0
+        self.expired = 0
+        self.completed = 0
+        self.errors = 0
+        self.shut_down = 0
+        self.batches = 0
+        self.new_tokens = 0
+        self.busy_s = 0.0
+        self._occupancy_sum = 0.0
+        self._total_s: list[float] = []
+        self._queue_s: list[float] = []
+
+    def _emit(self, **fields) -> None:
+        log = self._log or get_default_event_log()
+        if log is not None:
+            log.event("serve", **fields)
+
+    def record_enqueue(self, rid: int, bucket, depth: int) -> None:
+        with self._lock:
+            self.submitted += 1
+        self._emit(ev="enqueue", rid=rid, bucket=list(bucket), depth=depth)
+
+    def record_reject(self, rid: int, reason: str) -> None:
+        with self._lock:
+            self.rejected += 1
+        self._emit(ev="reject", rid=rid, reason=reason)
+
+    def record_batch(self, bucket, rows: int, max_batch: int,
+                     new_tokens: int, seconds: float) -> None:
+        with self._lock:
+            self.batches += 1
+            self.new_tokens += new_tokens
+            self.busy_s += seconds
+            self._occupancy_sum += rows / max_batch
+        self._emit(ev="batch", bucket=list(bucket), rows=rows,
+                   occupancy=round(rows / max_batch, 4),
+                   new_tokens=new_tokens, seconds=seconds,
+                   tok_s=round(new_tokens / max(seconds, 1e-9), 2))
+
+    def record_result(self, rid: int, status: str, bucket=None,
+                      queue_s: float | None = None,
+                      total_s: float | None = None) -> None:
+        with self._lock:
+            if status == "ok":
+                self.completed += 1
+            elif status == "expired":
+                self.expired += 1
+            elif status == "error":
+                self.errors += 1
+            elif status == "shutting_down":
+                self.shut_down += 1
+            if total_s is not None and len(self._total_s) < self._keep:
+                self._total_s.append(total_s)
+            if queue_s is not None and len(self._queue_s) < self._keep:
+                self._queue_s.append(queue_s)
+        fields = {"ev": "result", "rid": rid, "status": status}
+        if bucket is not None:
+            fields["bucket"] = list(bucket)
+        if queue_s is not None:
+            fields["queue_s"] = queue_s
+        if total_s is not None:
+            # gang scheduling: the first token surfaces with the whole batch
+            fields["ttft_s"] = total_s
+            fields["total_s"] = total_s
+        self._emit(**fields)
+
+    def snapshot(self) -> dict:
+        """One aggregate dict: counters plus occupancy mean, tokens/s over
+        engine busy time, and p50/p99 total latency (None until data)."""
+        with self._lock:
+            lat = list(self._total_s)
+            qs = list(self._queue_s)
+            out = {
+                "submitted": self.submitted, "rejected": self.rejected,
+                "expired": self.expired, "completed": self.completed,
+                "errors": self.errors, "shut_down": self.shut_down,
+                "batches": self.batches, "new_tokens": self.new_tokens,
+                "busy_s": round(self.busy_s, 6),
+                "occupancy_mean": (round(self._occupancy_sum / self.batches, 4)
+                                   if self.batches else None),
+                "tok_s": (round(self.new_tokens / self.busy_s, 2)
+                          if self.busy_s > 0 else None),
+            }
+        out["p50_total_s"] = percentile(lat, 50) if lat else None
+        out["p99_total_s"] = percentile(lat, 99) if lat else None
+        out["p50_queue_s"] = percentile(qs, 50) if qs else None
+        return out

@@ -1,0 +1,191 @@
+"""Request/Result contracts and bounded admission for the serving engine.
+
+The reference delegated all request scheduling to Spark (SURVEY.md §0); the
+TPU-native rebuild supplies its own front half, and this module is its wire
+format: a :class:`Request` carries one prompt plus its serving policy
+(deadline, priority, sampling knobs), a :class:`Result` is the exactly-once
+answer every submitted request eventually receives — completed, rejected,
+expired, errored, or shut down, but never silently dropped — and
+:class:`AdmissionQueue` is the backpressure gate in front of the batch
+former: a submission is admitted only while both the queue-depth bound and
+the in-flight KV-cache HBM budget (defaulting to the planner's measured
+:func:`~marlin_tpu.models.planner.usable_hbm_bytes`) have room, and a full
+queue rejects with a reason instead of blocking the caller.
+
+Everything here is stdlib + numpy; the engine (engine.py) owns the JAX side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from typing import Any
+
+import numpy as np
+
+__all__ = ["Request", "Result", "ResultHandle", "AdmissionQueue",
+           "STATUS_OK", "STATUS_REJECTED", "STATUS_EXPIRED", "STATUS_ERROR",
+           "STATUS_SHUTTING_DOWN"]
+
+#: terminal statuses a :class:`Result` can carry
+STATUS_OK = "ok"                          # decoded; ``tokens`` is set
+STATUS_REJECTED = "rejected"              # refused at admission (see reason)
+STATUS_EXPIRED = "expired"                # deadline passed before decode
+STATUS_ERROR = "error"                    # the batch it rode in failed
+STATUS_SHUTTING_DOWN = "shutting_down"    # queued at close(); never decoded
+
+_rid_counter = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``prompt`` is a 1-D int32 token array; ``steps`` how many tokens to
+    generate (the bucket rounds it up for execution, the :class:`Result`
+    slices back down). ``deadline`` is an *absolute* time on the engine's
+    clock (``None`` = no deadline): a request whose deadline has passed when
+    its batch forms is retired with :data:`STATUS_EXPIRED` rather than
+    decoded late. ``priority`` orders dispatch within a bucket (higher
+    first; FIFO among equals). Sampling knobs mirror
+    :func:`~marlin_tpu.models.transformer.lm_generate_batch` — requests with
+    different knobs never share a batch (one traced temperature per program
+    invocation). ``seed`` feeds the batch PRNG key: sampled requests
+    (temperature > 0) batch only with same-seed peers, so a different seed's
+    randomness never silently replaces this one's; each slot row then draws
+    its own stream from that key, so exact replay of a sampled output needs
+    the same seed AND the same submission pattern (batch width is fixed, so
+    the row index is what matters). Greedy decode, the default, ignores the
+    key and batches across seeds freely (docs/serving.md)."""
+
+    prompt: Any
+    steps: int
+    deadline: float | None = None
+    priority: int = 0
+    temperature: float = 0.0
+    top_p: float | None = None
+    top_k: int | None = None
+    seed: int = 0
+    rid: int = dataclasses.field(default_factory=lambda: next(_rid_counter))
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("empty prompt")
+        if self.steps < 1:
+            raise ValueError(f"steps must be >= 1, got {self.steps}")
+
+
+@dataclasses.dataclass
+class Result:
+    """The exactly-once answer to one :class:`Request`. ``tokens`` (status
+    :data:`STATUS_OK` only) is prompt + the requested ``steps`` generated
+    tokens, sliced from the bucket row. ``metrics`` carries the per-request
+    timings (``queue_s``, ``ttft_s``, ``total_s`` — on the engine clock) and
+    the ``bucket`` that executed it."""
+
+    rid: int
+    status: str
+    tokens: np.ndarray | None = None
+    reason: str = ""
+    metrics: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return self.status == STATUS_OK
+
+
+class ResultHandle:
+    """Caller-side future for one request: ``result(timeout)`` blocks until
+    the engine retires the request. The engine sets each handle exactly once
+    — a second ``_set`` is a scheduler bug and raises."""
+
+    def __init__(self, request: Request):
+        self.request = request
+        self._event = threading.Event()
+        self._result: Result | None = None
+
+    def _set(self, result: Result) -> None:
+        if self._event.is_set():  # pragma: no cover - guards engine bugs
+            raise RuntimeError(
+                f"request {self.request.rid} retired twice "
+                f"(had {self._result.status}, got {result.status})")
+        self._result = result
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> Result:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request.rid} not done within {timeout}s")
+        return self._result
+
+    def __repr__(self):
+        state = self._result.status if self.done() else "pending"
+        return f"ResultHandle(rid={self.request.rid}, {state})"
+
+
+class AdmissionQueue:
+    """Depth + HBM-byte admission gate with reject-with-reason backpressure.
+
+    Tracks every admitted-but-not-retired request: ``depth`` bounds how many
+    may be pending or in flight at once, ``budget_bytes`` bounds the summed
+    KV-cache cost the engine would hold if everything admitted ran (cost per
+    request = its bucket row's cache bytes, :func:`..serving.batcher
+    .bucket_kv_bytes`). ``try_admit`` returns ``None`` on admission or the
+    rejection reason string; ``release`` returns the request's capacity when
+    the engine retires it. ``close(reason)`` flips the gate shut (drain /
+    shutdown) — everything after is rejected with that reason."""
+
+    def __init__(self, depth: int, budget_bytes: int):
+        if depth < 1:
+            raise ValueError(f"queue depth must be >= 1, got {depth}")
+        self.depth = depth
+        self.budget_bytes = int(budget_bytes)
+        self._lock = threading.Lock()
+        self._count = 0
+        self._bytes = 0
+        self._closed_reason: str | None = None
+
+    def try_admit(self, cost_bytes: int) -> str | None:
+        with self._lock:
+            if self._closed_reason is not None:
+                return self._closed_reason
+            if self._count >= self.depth:
+                return (f"queue full ({self._count}/{self.depth} requests "
+                        f"pending or in flight)")
+            # at least one request is always admissible, else an oversized
+            # budgetless config would deadlock the whole engine
+            if (self._count and self.budget_bytes
+                    and self._bytes + cost_bytes > self.budget_bytes):
+                return (f"HBM admission budget exhausted ({self._bytes} + "
+                        f"{cost_bytes} > {self.budget_bytes} bytes of "
+                        f"in-flight KV cache)")
+            self._count += 1
+            self._bytes += cost_bytes
+            return None
+
+    def release(self, cost_bytes: int) -> None:
+        with self._lock:
+            self._count -= 1
+            self._bytes -= cost_bytes
+            assert self._count >= 0 and self._bytes >= 0, \
+                "admission release without admit"
+
+    def close(self, reason: str) -> None:
+        with self._lock:
+            if self._closed_reason is None:
+                self._closed_reason = reason
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def bytes_in_flight(self) -> int:
+        with self._lock:
+            return self._bytes

@@ -23,6 +23,13 @@ point               fires from
                     :class:`~marlin_tpu.parallel.prefetch.ChunkPrefetcher`
                     before each source-chunk read (ctx carries
                     ``path="chunk-<i>"`` so ``match`` can target one chunk)
+``serve.enqueue``   :meth:`~marlin_tpu.serving.engine.ServeEngine.submit`
+                    entry (ctx carries ``path=<rid>``) — a raise here
+                    surfaces to the submitting caller
+``serve.step``      the serving worker loop, just before each batch launch
+                    (ctx carries ``path="bucket-<P>x<steps>"``) — a raise
+                    fails that batch's requests with ``error`` Results; the
+                    engine keeps serving
 ==================  =========================================================
 
 Behaviors are :class:`Fault` subclasses — :class:`RaiseFault` (raise once /
@@ -55,7 +62,7 @@ __all__ = [
 
 KNOWN_POINTS = frozenset({
     "ckpt.write", "ckpt.manifest", "fs.open", "fs.list", "step.run",
-    "device.probe", "prefetch.produce",
+    "device.probe", "prefetch.produce", "serve.enqueue", "serve.step",
 })
 
 

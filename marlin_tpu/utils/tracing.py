@@ -17,6 +17,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
 from typing import Any
 
@@ -50,20 +51,43 @@ class EventLog:
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "a")
+        # writers are concurrent (serving workers, prefetch producers, the
+        # submitting thread): a shared handle without a lock interleaves
+        # partial lines, corrupting the JSONL stream
+        self._lock = threading.Lock()
 
     def event(self, kind: str, **fields: Any) -> None:
         rec = {"t": time.time(), "kind": kind, **fields}
-        self._f.write(json.dumps(rec) + "\n")
-        self._f.flush()
+        line = json.dumps(rec) + "\n"
+        with self._lock:
+            if self._f.closed:
+                return  # a worker racing close() drops its record rather
+                # than killing its thread — observability must stay passive
+            self._f.write(line)
+            self._f.flush()
 
     @contextlib.contextmanager
     def timed(self, kind: str, **fields: Any):
+        """Times the body; the record lands even when the body raises
+        (tagged ``ok=False``) — a crash is exactly when the post-mortem
+        needs the timing, not when it should vanish."""
         t0 = time.perf_counter()
-        yield
-        self.event(kind, seconds=time.perf_counter() - t0, **fields)
+        ok = True
+        try:
+            yield
+        except BaseException:
+            ok = False
+            raise
+        finally:
+            self.event(kind, seconds=time.perf_counter() - t0, ok=ok,
+                       **fields)
 
     def close(self) -> None:
-        self._f.close()
+        # under the write lock: closing mid-event from another thread would
+        # raise "I/O operation on closed file" inside the writer
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
 
     def read(self) -> list[dict]:
         with open(self.path) as f:

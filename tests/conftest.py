@@ -16,24 +16,29 @@ import pytest
 import marlin_tpu as mt
 
 
-def _prefetch_threads():
+# worker-thread name prefixes owned by the library; each subsystem joins its
+# workers on close (ChunkPrefetcher.close, ServeEngine.drain/close), so any
+# survivor after a test is a leak in that test or that subsystem
+_WORKER_PREFIXES = ("marlin-prefetch", "marlin-serve")
+
+
+def _worker_threads():
     return [t for t in threading.enumerate()
-            if t.name.startswith("marlin-prefetch")]
+            if t.name.startswith(_WORKER_PREFIXES)]
 
 
 @pytest.fixture(autouse=True)
-def _no_prefetch_thread_leaks():
-    """No prefetch worker may outlive its pipeline: ChunkPrefetcher joins its
-    threads on close/exhaustion, so a surviving marlin-prefetch-* thread after
-    a test is a leak in that test (or in the prefetcher itself). Mirrors the
-    fault-registry leak check below. A short grace window absorbs workers
-    mid-observation of the stop flag."""
+def _no_worker_thread_leaks():
+    """No library worker may outlive its owner: prefetch producers join on
+    pipeline close/exhaustion, serving workers join inside drain()/close().
+    Mirrors the fault-registry leak check below. A short grace window absorbs
+    workers mid-observation of their stop flag."""
     yield
     deadline = time.monotonic() + 2.0
-    while _prefetch_threads() and time.monotonic() < deadline:
+    while _worker_threads() and time.monotonic() < deadline:
         time.sleep(0.01)
-    leaked = _prefetch_threads()
-    assert not leaked, f"prefetch thread(s) leaked across tests: {leaked}"
+    leaked = _worker_threads()
+    assert not leaked, f"worker thread(s) leaked across tests: {leaked}"
 
 
 @pytest.fixture(autouse=True)

@@ -118,6 +118,47 @@ def test_event_log(tmp_path):
     events = log.read()
     assert events[0]["kind"] == "step" and events[0]["loss"] == 0.5
     assert events[1]["kind"] == "matmul" and events[1]["seconds"] >= 0
+    assert events[1]["ok"] is True
+
+
+def test_event_log_timed_records_on_raise(tmp_path):
+    """timed() must land its record even when the body raises — a crash is
+    exactly when the post-mortem needs the timing — tagged ok=False."""
+    log = EventLog(str(tmp_path / "ev.jsonl"))
+    with pytest.raises(RuntimeError, match="boom"):
+        with log.timed("step", step=3):
+            raise RuntimeError("boom")
+    log.close()
+    (rec,) = log.read()
+    assert rec["kind"] == "step" and rec["step"] == 3
+    assert rec["ok"] is False and rec["seconds"] >= 0
+
+
+def test_event_log_concurrent_writers(tmp_path):
+    """event() is called from serving/prefetch worker threads concurrently;
+    every line of the shared-handle JSONL stream must stay parseable and no
+    record may be lost (the write+flush lock)."""
+    import threading
+
+    log = EventLog(str(tmp_path / "ev.jsonl"))
+    n_threads, per_thread = 8, 200
+
+    def writer(tid):
+        for i in range(per_thread):
+            log.event("w", tid=tid, i=i, pad="x" * 64)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    log.close()
+    log.close()  # idempotent — teardown paths may race a second close
+    events = log.read()  # json.loads on every line — interleaving would blow
+    assert len(events) == n_threads * per_thread
+    seen = {(e["tid"], e["i"]) for e in events}
+    assert len(seen) == n_threads * per_thread
 
 
 def test_axpy_and_triu_to_full():

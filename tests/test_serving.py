@@ -1,0 +1,525 @@
+"""Serving-engine suite: admission, bucketing, lifecycle, chaos, metrics.
+
+The acceptance scenario (test_acceptance_continuous_batching) drives 36
+concurrent requests across two shape buckets through :class:`ServeEngine` on
+an injectable clock and asserts the subsystem's four contracts: exactly one
+Result per request, outputs bit-identical to calling
+:func:`lm_generate_batch` directly on the same bucket shape, deadline
+expiry surfaced (never silently dropped), and a compile count bounded by the
+bucket count. Everything runs greedy/seeded on the CPU mesh, so it is fully
+deterministic.
+"""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+import jax
+
+from marlin_tpu.models import TransformerLM
+from marlin_tpu.models.transformer import lm_generate_batch
+from marlin_tpu.serving import (
+    STATUS_ERROR,
+    STATUS_EXPIRED,
+    STATUS_OK,
+    STATUS_REJECTED,
+    STATUS_SHUTTING_DOWN,
+    AdmissionQueue,
+    BatchFormer,
+    Request,
+    ServeEngine,
+    bucket_kv_bytes,
+    normalize_buckets,
+    percentile,
+    pick_bucket,
+)
+from marlin_tpu.utils import EventLog, faults
+from marlin_tpu.utils.faults import FaultInjected, RaiseFault, Schedule
+
+HEADS = 2
+BUCKETS = ((8, 4), (16, 4))
+
+
+class FakeClock:
+    """Deterministic engine clock: only advances when the test says so."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def params():
+    """One tiny LM for the whole module, so every engine shares the jit
+    cache (compile-count assertions measure deltas, not absolutes)."""
+    return TransformerLM(vocab=32, d_model=16, heads=HEADS, layers=2,
+                         seed=9).init_params()
+
+
+def _engine(params, **kw):
+    kw.setdefault("buckets", BUCKETS)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 0.0)
+    kw.setdefault("queue_depth", 64)
+    return ServeEngine(params, HEADS, **kw)
+
+
+def _reference(params, prompt, steps_req, bucket):
+    """What the engine MUST produce for one request: lm_generate_batch called
+    directly on the request's bucket shape (greedy, so batch composition and
+    the PRNG key cannot change the row)."""
+    p, s = bucket
+    n = len(prompt)
+    padded = np.zeros((1, p), np.int32)
+    padded[0, :n] = prompt
+    out = np.asarray(lm_generate_batch(
+        params, padded, np.array([n], np.int32), jax.random.key(0),
+        heads=HEADS, max_len=p + s, steps=s))
+    return out[0, : n + steps_req]
+
+
+# --------------------------------------------------------------- unit layer
+
+
+def test_normalize_and_pick_bucket():
+    assert normalize_buckets([(16, 4), (8, 4)]) == ((8, 4), (16, 4))
+    assert pick_bucket(3, 4, BUCKETS) == (8, 4)
+    assert pick_bucket(8, 4, BUCKETS) == (8, 4)     # exact fit, no pad
+    assert pick_bucket(9, 4, BUCKETS) == (16, 4)
+    assert pick_bucket(17, 4, BUCKETS) is None      # prompt too long
+    assert pick_bucket(4, 5, BUCKETS) is None       # steps too deep
+    with pytest.raises(ValueError, match="duplicate"):
+        normalize_buckets([(8, 4), (8, 4)])
+    with pytest.raises(ValueError, match="at least one"):
+        normalize_buckets([])
+
+
+def test_bucket_kv_bytes(params):
+    # layers(2) x k&v(2) x max_len(8+4) x kv_heads(2) x dh(8) x f32(4)
+    assert bucket_kv_bytes(params, HEADS, (8, 4)) == 2 * 2 * 12 * 2 * 8 * 4
+    assert bucket_kv_bytes(params, HEADS, (8, 4), batch=4) == \
+        4 * bucket_kv_bytes(params, HEADS, (8, 4))
+    # bf16 halves the cache — the GQA/serving memory story end to end
+    assert bucket_kv_bytes(params, HEADS, (8, 4), compute_dtype="bfloat16") \
+        == bucket_kv_bytes(params, HEADS, (8, 4)) // 2
+
+
+def test_admission_queue_bounds():
+    q = AdmissionQueue(depth=2, budget_bytes=100)
+    assert q.try_admit(60) is None
+    assert "HBM" in q.try_admit(60)          # byte budget
+    assert q.try_admit(30) is None
+    assert "queue full" in q.try_admit(1)    # depth
+    q.release(60)
+    assert q.try_admit(1) is None
+    q.close("draining")
+    assert q.try_admit(1) == "draining"
+
+
+def test_admission_queue_oversized_first_request():
+    """A request dearer than the whole budget must still admit when the
+    queue is empty — otherwise it deadlocks the engine forever."""
+    q = AdmissionQueue(depth=4, budget_bytes=10)
+    assert q.try_admit(1000) is None
+    assert "HBM" in q.try_admit(1)
+
+
+def _stub_entry(priority=0, enq_t=0.0, bucket=(8, 4)):
+    r = types.SimpleNamespace(priority=priority, temperature=0.0,
+                              top_p=None, top_k=None, seed=0)
+    return types.SimpleNamespace(request=r, enq_t=enq_t, bucket=bucket)
+
+
+def test_batch_former_wait_and_priority():
+    f = BatchFormer(BUCKETS, max_batch=2, max_wait=1.0)
+    f.add(_stub_entry(priority=0, enq_t=0.0))
+    key, hint = f.next_batch(now=0.5)
+    assert key is None and hint == pytest.approx(0.5)   # not ripe yet
+    key, batch = f.next_batch(now=1.0)                  # max_wait reached
+    assert key[0] == (8, 4) and len(batch) == 1
+    # full batch dispatches immediately; higher priority rides first
+    for pri in (1, 5, 3):
+        f.add(_stub_entry(priority=pri, enq_t=2.0))
+    key, batch = f.next_batch(now=2.0)
+    assert [e.request.priority for e in batch] == [5, 3]
+    # force (the drain path) flushes the unripe leftover
+    key, batch = f.next_batch(now=2.0, force=True)
+    assert [e.request.priority for e in batch] == [1]
+    assert f.pending() == 0
+
+
+def test_batch_former_groups_by_sampling_knobs():
+    f = BatchFormer(BUCKETS, max_batch=4, max_wait=0.0)
+    a, b = _stub_entry(), _stub_entry()
+    b.request.temperature = 0.7
+    f.add(a)
+    f.add(b)
+    key1, batch1 = f.next_batch(now=0.0)
+    key2, batch2 = f.next_batch(now=0.0)
+    assert len(batch1) == len(batch2) == 1
+    assert {key1[1], key2[1]} == {0.0, 0.7}
+
+
+def test_batch_former_sampled_requests_never_share_across_seeds():
+    """A batch decodes under ONE PRNG key, so a sampled request must only
+    ride with same-seed peers — different seeds sharing a batch would
+    silently hand one request the other's stream. Greedy requests ignore
+    the key: seeds must NOT fragment their batches."""
+    f = BatchFormer(BUCKETS, max_batch=4, max_wait=0.0)
+    for seed in (1, 2, 1):
+        e = _stub_entry()
+        e.request.temperature = 0.7
+        e.request.seed = seed
+        f.add(e)
+    _, b1 = f.next_batch(now=0.0)
+    _, b2 = f.next_batch(now=0.0)
+    assert sorted(len(b) for b in (b1, b2)) == [1, 2]
+    # greedy: different seeds, one batch
+    for seed in (1, 2):
+        e = _stub_entry()
+        e.request.seed = seed
+        f.add(e)
+    _, b3 = f.next_batch(now=0.0)
+    assert len(b3) == 2
+
+
+# ------------------------------------------------------------- engine layer
+
+
+def test_acceptance_continuous_batching(params):
+    """The tentpole acceptance: >= 32 concurrent requests, >= 2 buckets,
+    deterministic clock — exactly one Result each, bit-identical to the
+    direct lm_generate_batch call, expired deadlines surfaced, drain()
+    completes in-flight work, <= one compile per bucket."""
+    clock = FakeClock()
+    rng = np.random.default_rng(4)
+    reqs = []
+    for i in range(32):
+        n = int(rng.integers(2, 17))            # both buckets exercised
+        steps = int(rng.integers(1, 5))
+        reqs.append(Request(prompt=rng.integers(0, 32, n).astype(np.int32),
+                            steps=steps, seed=0))
+    expired = [Request(prompt=[1, 2], steps=2, deadline=-1.0)
+               for _ in range(4)]
+
+    probe = getattr(lm_generate_batch, "_cache_size", None)
+    before = probe() if probe else None
+
+    eng = _engine(params, clock=clock)
+    try:
+        handles = {}
+        lock = threading.Lock()
+
+        def submit(chunk):
+            for r in chunk:
+                h = eng.submit(r)
+                with lock:
+                    handles[r.rid] = h
+
+        threads = [threading.Thread(target=submit, args=(reqs[i::4],))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for r in expired:           # resolved synchronously, still a Result
+            handles[r.rid] = eng.submit(r)
+
+        results = {rid: h.result(timeout=120) for rid, h in handles.items()}
+        # exactly one Result per request, none dropped
+        assert len(results) == 36
+        assert all(h.done() for h in handles.values())
+
+        # deadline expiry is surfaced, not silently dropped
+        for r in expired:
+            assert results[r.rid].status == STATUS_EXPIRED
+            assert "deadline" in results[r.rid].reason
+
+        # compile count: at most one program per bucket (measured BEFORE the
+        # direct-call references below add their own B=1 programs)
+        if probe:
+            assert probe() - before <= len(BUCKETS), \
+                f"recompiled: {probe() - before} programs for {BUCKETS}"
+
+        # bit-identical to the direct call on the same bucket shape
+        for r in reqs:
+            res = results[r.rid]
+            assert res.status == STATUS_OK, (r.rid, res.reason)
+            bucket = pick_bucket(len(r.prompt), r.steps, BUCKETS)
+            ref = _reference(params, r.prompt, r.steps, bucket)
+            assert res.tokens.tolist() == ref.tolist(), r.rid
+            assert res.metrics["bucket"] == bucket
+            assert res.metrics["total_s"] >= 0.0
+
+        # drain() completes in-flight work (fresh wave, then drain)
+        tail = [eng.submit(Request(prompt=[7, 8, 9], steps=2))
+                for _ in range(3)]
+        eng.drain()
+        for h in tail:
+            assert h.result(timeout=5).status == STATUS_OK
+        assert eng.pending() == 0
+
+        snap = eng.metrics.snapshot()
+        assert snap["completed"] == 35 and snap["expired"] == 4
+        assert snap["submitted"] == 35  # expired-at-submit never enqueued
+    finally:
+        eng.close()
+
+
+def test_queue_full_rejects(params):
+    eng = _engine(params, queue_depth=2, start=False)
+    try:
+        a = eng.submit(Request(prompt=[1], steps=1))
+        b = eng.submit(Request(prompt=[2], steps=1))
+        c = eng.submit(Request(prompt=[3], steps=1))
+        assert not a.done() and not b.done()
+        r = c.result(timeout=1)
+        assert r.status == STATUS_REJECTED and "queue full" in r.reason
+    finally:
+        eng.close()
+    assert a.result(timeout=1).status == STATUS_SHUTTING_DOWN
+
+
+def test_hbm_budget_rejects(params):
+    eng = _engine(params, hbm_budget_bytes=1, start=False)
+    try:
+        a = eng.submit(Request(prompt=[1], steps=1))   # first always admits
+        b = eng.submit(Request(prompt=[2], steps=1))
+        assert not a.done()
+        r = b.result(timeout=1)
+        assert r.status == STATUS_REJECTED and "HBM" in r.reason
+    finally:
+        eng.close()
+
+
+def test_no_bucket_rejects(params):
+    with _engine(params) as eng:
+        r = eng.submit(Request(prompt=list(range(30)), steps=2)) \
+            .result(timeout=1)
+        assert r.status == STATUS_REJECTED and "no bucket" in r.reason
+        r = eng.submit(Request(prompt=[1], steps=99)).result(timeout=1)
+        assert r.status == STATUS_REJECTED and "no bucket" in r.reason
+
+
+def test_deadline_expired_at_dispatch(params):
+    """Requests admitted in time but dispatched late are retired expired —
+    the retire-expired-rows half of the engine cycle."""
+    clock = FakeClock()
+    eng = _engine(params, clock=clock, start=False)
+    try:
+        stale = [eng.submit(Request(prompt=[1, 2], steps=2, deadline=5.0))
+                 for _ in range(2)]
+        fresh = eng.submit(Request(prompt=[1, 2], steps=2, deadline=1e9))
+        clock.advance(10.0)          # past the stale deadlines, engine idle
+        eng.start()
+        for h in stale:
+            r = h.result(timeout=30)
+            assert r.status == STATUS_EXPIRED and "before dispatch" in r.reason
+        assert fresh.result(timeout=30).status == STATUS_OK
+    finally:
+        eng.close()
+
+
+def test_close_retires_queued_with_shutting_down(params):
+    eng = _engine(params, start=False)
+    handles = [eng.submit(Request(prompt=[i + 1], steps=1)) for i in range(3)]
+    eng.close()
+    for h in handles:
+        r = h.result(timeout=1)
+        assert r.status == STATUS_SHUTTING_DOWN and "closed" in r.reason
+    assert eng.pending() == 0
+    # close is terminal for admission too
+    r = eng.submit(Request(prompt=[9], steps=1)).result(timeout=1)
+    assert r.status == STATUS_REJECTED
+
+
+def test_serve_step_fault_fails_batch_and_engine_recovers(params):
+    """Chaos: a serve.step fault kills one batch mid-flight — its requests
+    get error Results (never dropped), and the engine keeps serving."""
+    with _engine(params) as eng:
+        with faults.injected("serve.step", RaiseFault(times=1)):
+            bad = eng.submit(Request(prompt=[1, 2], steps=2))
+            r = bad.result(timeout=60)
+            assert r.status == STATUS_ERROR and "FaultInjected" in r.reason
+        good = eng.submit(Request(prompt=[1, 2], steps=2))
+        assert good.result(timeout=60).status == STATUS_OK
+        snap = eng.metrics.snapshot()
+        assert snap["errors"] == 1 and snap["completed"] == 1
+    assert eng.pending() == 0
+
+
+def test_serve_enqueue_fault_propagates_to_caller(params):
+    with _engine(params, start=False) as eng:
+        with faults.injected("serve.enqueue", RaiseFault(times=1)):
+            with pytest.raises(FaultInjected):
+                eng.submit(Request(prompt=[1], steps=1))
+        assert eng.pending() == 0      # nothing admitted by the failed call
+        h = eng.submit(Request(prompt=[1], steps=1))
+        assert eng.pending() == 1
+        eng.drain()
+        assert h.result(timeout=30).status == STATUS_OK
+
+
+def test_metrics_eventlog_records(params, tmp_path):
+    log = EventLog(str(tmp_path / "serve.jsonl"))
+    with _engine(params, log=log) as eng:
+        hs = [eng.submit(Request(prompt=[1, 2, 3], steps=2))
+              for _ in range(3)]
+        for h in hs:
+            assert h.result(timeout=60).status == STATUS_OK
+        eng.submit(Request(prompt=list(range(30)), steps=1)).result(timeout=1)
+    recs = [r for r in log.read() if r["kind"] == "serve"]
+    evs = [r["ev"] for r in recs]
+    assert evs.count("enqueue") == 3 and evs.count("reject") == 1
+    batches = [r for r in recs if r["ev"] == "batch"]
+    assert batches and all(0.0 < b["occupancy"] <= 1.0 for b in batches)
+    assert sum(b["rows"] for b in batches) == 3
+    results = [r for r in recs if r["ev"] == "result" and r["status"] == "ok"]
+    assert len(results) == 3
+    for r in results:
+        assert r["ttft_s"] == r["total_s"] >= r["queue_s"] >= 0.0
+
+
+def test_sampling_knobs_partition_batches(params):
+    """Different sampling knobs never share a batch; a traced temperature
+    difference costs a second dispatch, not a second compile."""
+    probe = getattr(lm_generate_batch, "_cache_size", None)
+    eng = _engine(params, start=False)
+    try:
+        cold = [eng.submit(Request(prompt=[1, 2], steps=2))
+                for _ in range(2)]
+        hot = [eng.submit(Request(prompt=[1, 2], steps=2, temperature=0.7,
+                                  seed=3)) for _ in range(2)]
+        before = probe() if probe else None
+        eng.start()
+        eng.drain()
+        for h in cold + hot:
+            assert h.result(timeout=1).status == STATUS_OK
+        assert eng.metrics.snapshot()["batches"] == 2
+        # greedy rows are key/temperature-independent: cold rows must equal
+        # the greedy reference even though a sampled group ran alongside
+        ref = _reference(params, [1, 2], 2, (8, 4))
+        for h in cold:
+            assert h.result().tokens.tolist() == ref.tolist()
+        if probe:
+            assert probe() - before <= 1  # temperature is traced, not static
+    finally:
+        eng.close()
+
+
+def test_priority_orders_dispatch(params, tmp_path):
+    """Higher-priority requests claim slots first when a bucket queue is
+    deeper than one batch."""
+    log = EventLog(str(tmp_path / "serve.jsonl"))
+    eng = _engine(params, log=log, start=False)
+    try:
+        low = [eng.submit(Request(prompt=[1, 2], steps=2, priority=0))
+               for _ in range(4)]
+        high = [eng.submit(Request(prompt=[3, 4], steps=2, priority=5))
+                for _ in range(4)]
+        eng.start()
+        eng.drain()
+        for h in low + high:
+            assert h.result(timeout=1).status == STATUS_OK
+    finally:
+        eng.close()
+    order = [r["rid"] for r in log.read()
+             if r["kind"] == "serve" and r.get("ev") == "result"]
+    high_rids = {h.request.rid for h in high}
+    assert set(order[:4]) == high_rids, order
+
+
+def test_warmup_then_traffic_compiles_nothing(params):
+    """warmup() pays every bucket's compile up front; traffic afterwards
+    (same greedy signature) adds zero programs."""
+    probe = getattr(lm_generate_batch, "_cache_size", None)
+    if probe is None:
+        pytest.skip("jit cache probe unavailable on this JAX")
+    with _engine(params) as eng:
+        assert eng.warmup() == len(BUCKETS)
+        before = probe()
+        hs = [eng.submit(Request(prompt=[1] * n, steps=2))
+              for n in (2, 5, 8, 12, 16)]
+        for h in hs:
+            assert h.result(timeout=60).status == STATUS_OK
+        assert probe() == before, "serving traffic recompiled after warmup"
+
+
+def test_drain_idempotent_and_usable_from_context(params):
+    with _engine(params) as eng:
+        h = eng.submit(Request(prompt=[5, 6], steps=2))
+        eng.drain()
+        assert h.result(timeout=1).status == STATUS_OK
+        eng.drain()   # terminal + idempotent
+        r = eng.submit(Request(prompt=[5], steps=1)).result(timeout=1)
+        assert r.status == STATUS_REJECTED and "draining" in r.reason
+
+
+def test_percentile_helper():
+    xs = list(range(1, 101))
+    assert percentile(xs, 50) == 50
+    assert percentile(xs, 99) == 99
+    assert percentile([7.0], 99) == 7.0
+    with pytest.raises(ValueError):
+        percentile([], 50)
+
+
+def test_aot_compile_buckets_reports_hbm(params):
+    """Compile-only TPU evidence for bucket sizing (needs libtpu)."""
+    from marlin_tpu.serving import aot_compile_buckets
+    from marlin_tpu.utils.aot import supports_aot_tpu
+
+    if not supports_aot_tpu():
+        pytest.skip("no libtpu: compile-only TPU topology unavailable")
+    peaks = aot_compile_buckets(params, HEADS, [(8, 4)], max_batch=2)
+    assert set(peaks) == {(8, 4)} and peaks[(8, 4)] > 0
+
+
+@pytest.mark.slow
+def test_serving_soak_with_chaos(params):
+    """Multi-minute-class soak: concurrent submitters, probabilistic
+    serve.step chaos, ragged sizes — every request resolves, counters add
+    up, nothing leaks (conftest checks threads + fault registry)."""
+    rng = np.random.default_rng(11)
+    n_threads, per_thread = 4, 40
+    eng = _engine(params, queue_depth=n_threads * per_thread)
+    handles, lock = [], threading.Lock()
+
+    def submitter(seed):
+        r = np.random.default_rng(seed)
+        for _ in range(per_thread):
+            req = Request(prompt=r.integers(0, 32, int(r.integers(1, 17))),
+                          steps=int(r.integers(1, 5)),
+                          priority=int(r.integers(0, 3)))
+            h = eng.submit(req)
+            with lock:
+                handles.append(h)
+
+    try:
+        with faults.injected(
+                "serve.step",
+                RaiseFault(times=-1, schedule=Schedule(seed=3, rate=0.05))):
+            threads = [threading.Thread(target=submitter, args=(100 + i,))
+                       for i in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            eng.drain()
+        statuses = [h.result(timeout=300).status for h in handles]
+    finally:
+        eng.close()
+    assert len(statuses) == n_threads * per_thread
+    assert set(statuses) <= {STATUS_OK, STATUS_ERROR}
+    snap = eng.metrics.snapshot()
+    assert snap["completed"] == statuses.count(STATUS_OK)
+    assert snap["errors"] == statuses.count(STATUS_ERROR)
+    assert snap["completed"] + snap["errors"] == len(statuses)
+    assert eng.pending() == 0

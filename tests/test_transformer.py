@@ -441,6 +441,59 @@ def test_batched_decode_matches_single(mesh):
         assert got == single(r).tolist(), (b, got, single(r).tolist())
 
 
+def test_batched_decode_ragged_edge_cases(mesh):
+    """The ragged-batch edge geometry: a row with lengths[b] == P (zero pad
+    — the take_along_axis at lengths-1 reads the LAST prompt position), and a
+    shortest row whose whole generation [len, len+steps) finishes INSIDE the
+    pad region (its decode positions all address columns other rows treat as
+    prompt). Each row must still equal its batch-of-one decode."""
+    import jax
+
+    from marlin_tpu.models import lm_generate_batch
+
+    lm = TransformerLM(vocab=32, d_model=16, heads=2, layers=2, seed=9)
+    p = lm.init_params()
+    P, steps = 8, 3
+
+    def single(prompt):
+        return np.asarray(lm_generate(p, np.asarray(prompt, np.int32),
+                                      jax.random.key(0), heads=2,
+                                      max_len=len(prompt) + steps,
+                                      steps=steps))
+
+    # row 0: full length (no pad); row 1: len 2, generation ends at 5 < P;
+    # row 2: interior length
+    rag = [[5, 1, 9, 2, 7, 4, 3, 6], [12, 4], [11, 2, 2, 8, 1]]
+    lengths = np.array([8, 2, 5], np.int32)
+    assert lengths[0] == P and lengths[1] + steps < P
+    padded = np.zeros((3, P), np.int32)
+    for i, r in enumerate(rag):
+        padded[i, : len(r)] = r
+    out = np.asarray(lm_generate_batch(
+        p, padded, lengths, jax.random.key(0), heads=2,
+        max_len=P + steps, steps=steps))
+    for b, r in enumerate(rag):
+        got = out[b, : lengths[b] + steps].tolist()
+        assert got == single(r).tolist(), (b, got, single(r).tolist())
+    # the short row's pad columns beyond its generation stay untouched zeros
+    assert out[1, lengths[1] + steps: P].tolist() == [0] * (P - 5)
+
+
+def test_batched_decode_overflow_raises(mesh):
+    """P + steps > max_len is a hard error (a silent clamp would corrupt the
+    cache-position contract), mirroring the single-sequence path."""
+    import jax
+
+    from marlin_tpu.models import lm_generate_batch
+
+    lm = TransformerLM(vocab=16, d_model=16, heads=2, layers=1, seed=3)
+    p = lm.init_params()
+    prompts = np.zeros((2, 6), np.int32)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        lm_generate_batch(p, prompts, np.full(2, 6, np.int32),
+                          jax.random.key(0), heads=2, max_len=8, steps=4)
+
+
 def test_generate_batch_facade(mesh):
     """TransformerLM.generate_batch pads ragged prompts and returns per-row
     continuations of the right lengths."""

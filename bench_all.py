@@ -595,16 +595,27 @@ def config_decode(d_model=512, heads=8, layers=4, vocab=4096,
                f"for the prompt; linear score memory (AOT-asserted)")
 
 
-def config_serve(d_model=64, heads=4, layers=2, vocab=256):
+def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     """Offered-load sweep through the serving engine (marlin_tpu/serving/):
     submitters inject Poisson-ish open-loop traffic at each offered rate;
-    reported per rate are achieved tokens/s and p50/p99 end-to-end latency
-    (submit -> Result). Env control, MARLIN_BENCH_PREFETCH-style:
+    reported per rate are achieved tokens/s and p50/p99 end-to-end + TTFT
+    latency (submit -> Result / first token). Env control,
+    MARLIN_BENCH_PREFETCH-style:
     MARLIN_BENCH_SERVE_RATES (req/s list, default "4,16,64"),
     MARLIN_BENCH_SERVE_N (requests per rate, default 64),
     MARLIN_BENCH_SERVE_BATCH (slot width, default 8),
+    MARLIN_BENCH_SERVE_STEPS (decode-steps range "lo,hi", default "4,32" —
+    ragged output lengths, the traffic continuous batching exists for; the
+    gang scheduler decodes every request to the bucket's steps while
+    row-level retires at the requested steps),
     MARLIN_BENCH_SERVE_WARMUP=0 skips the per-bucket pre-compile (the
-    first-request-pays-the-compile A/B)."""
+    first-request-pays-the-compile A/B),
+    MARLIN_BENCH_SERVE_ROWLEVEL=0 is the gang-scheduler control for the
+    row-level A/B (docs/performance.md records the pair). The model
+    (d_model=128, heads=8, layers=4) is sized so decode COMPUTE is
+    non-trivial relative to dispatch — the serving regime; at toy sizes the
+    sweep measures Python/dispatch overhead, where a fused gang program
+    always looks best."""
     import jax  # noqa: F401  (backend init before threads)
 
     import marlin_tpu as mt  # noqa: F401
@@ -616,6 +627,9 @@ def config_serve(d_model=64, heads=4, layers=2, vocab=256):
     n_req = int(os.environ.get("MARLIN_BENCH_SERVE_N", 64))
     max_batch = int(os.environ.get("MARLIN_BENCH_SERVE_BATCH", 8))
     warmup = os.environ.get("MARLIN_BENCH_SERVE_WARMUP", "1") != "0"
+    rowlevel = os.environ.get("MARLIN_BENCH_SERVE_ROWLEVEL", "1") != "0"
+    steps_lo, steps_hi = (int(v) for v in os.environ.get(
+        "MARLIN_BENCH_SERVE_STEPS", "4,32").split(","))
     buckets = ((64, 32), (256, 32))
     lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
                       layers=layers, seed=0)
@@ -625,7 +639,7 @@ def config_serve(d_model=64, heads=4, layers=2, vocab=256):
     for rate in rates:
         eng = ServeEngine(params, heads, buckets=buckets,
                           max_batch=max_batch, max_wait_ms=5.0,
-                          queue_depth=4 * n_req)
+                          queue_depth=4 * n_req, rowlevel=rowlevel)
         try:
             if warmup:
                 eng.warmup()
@@ -639,7 +653,7 @@ def config_serve(d_model=64, heads=4, layers=2, vocab=256):
                 plen = int(rng.integers(8, 192))
                 handles.append(eng.submit(Request(
                     prompt=rng.integers(0, vocab, plen).astype(np.int32),
-                    steps=32)))
+                    steps=int(rng.integers(steps_lo, steps_hi + 1)))))
             eng.drain()
             span = time.perf_counter() - t_start
         finally:
@@ -647,17 +661,25 @@ def config_serve(d_model=64, heads=4, layers=2, vocab=256):
         results = [h.result(timeout=0) for h in handles]
         ok = [r for r in results if r.ok]
         lat = [r.metrics["total_s"] for r in ok]
+        ttft = [r.metrics["ttft_s"] for r in ok
+                if r.metrics.get("ttft_s") is not None]
         snap = eng.metrics.snapshot()
         toks = sum(r.tokens.size - len(h.request.prompt)
                    for h, r in zip(handles, results) if r.ok)
         # a fully-shed load point (admission rejecting everything, chaos
         # faults) is a degraded data point, not a sweep abort
-        p50 = f"{percentile(lat, 50) * 1e3:.0f}" if lat else "n/a"
-        p99 = f"{percentile(lat, 99) * 1e3:.0f}" if lat else "n/a"
-        record(f"serve_load{rate:g}", toks / span, "tok/s",
+        ms = lambda xs, q: (  # noqa: E731
+            f"{percentile(xs, q) * 1e3:.0f}" if xs else "n/a")
+        sched = (f"row-level, {snap['steps']} decode steps"
+                 if rowlevel else f"gang, {snap['batches']} batches")
+        # the gang control keeps its own record key so the A/B pair
+        # coexists in BENCH_ALL.json (the merge is keyed by config name)
+        record(f"serve_load{rate:g}" + ("" if rowlevel else "_gang"),
+               toks / span, "tok/s",
                f"{len(ok)}/{n_req} ok at {rate:g} req/s offered; p50 "
-               f"{p50} ms / p99 {p99} ms latency; occupancy "
-               f"{snap['occupancy_mean']}, {snap['batches']} batches, "
+               f"{ms(lat, 50)} ms / p99 {ms(lat, 99)} ms latency; ttft p50 "
+               f"{ms(ttft, 50)} ms / p99 {ms(ttft, 99)} ms; occupancy "
+               f"{snap['occupancy_mean']}, {sched}, "
                f"warmup={'on' if warmup else 'off'}")
 
 

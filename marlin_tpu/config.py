@@ -102,6 +102,14 @@ class MarlinConfig:
     # one compile per sampling variant; prompts/steps round UP to the
     # smallest fitting bucket (docs/serving.md has tuning guidance).
     serve_buckets: tuple = ((64, 32), (256, 64))
+    # Row-level continuous batching (default): each bucket compiles TWO
+    # programs — slot-targeted prefill + a single-token decode step over a
+    # persistent device-resident KV slab — and the engine schedules per
+    # slot-step: finished/expired rows retire individually and freed slots
+    # refill from the queue on the very next step. False falls back to the
+    # gang scheduler (one fused program per bucket runs a whole batch to
+    # completion; rows land together). docs/serving.md compares the two.
+    serve_rowlevel: bool = True
     # --- autotune persistence (parallel/autotune.py) -------------------------
     # Where the empirical multiply-strategy winners persist across processes.
     # None = ~/.cache/marlin_tpu/autotune.json; "" disables the disk layer

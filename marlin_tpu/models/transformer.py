@@ -26,6 +26,7 @@ import numpy as np
 
 __all__ = ["TransformerLM", "init_transformer", "transformer_forward",
            "lm_loss", "lm_train_step", "lm_generate", "lm_generate_batch",
+           "init_kv_slab", "lm_prefill_slot", "lm_decode_rows",
            "synthetic_stream"]
 
 
@@ -707,11 +708,183 @@ def _lm_generate_batch_jit(params, prompts, lengths, key, heads: int,
     return tokens
 
 
+# --------------------------------------------------------------------------
+# Row-level serving: a persistent slot-resident KV slab + two small programs
+# (slot-targeted prefill, batched single-token decode) that the serving
+# engine's step scheduler composes. Unlike the fused lm_generate_batch (the
+# gang-scheduled serving shape, one program runs a batch to completion), the
+# slab lives on device ACROSS steps — rows enter via prefill into a free
+# slot and leave individually, so batch composition can change every step.
+# Greedy decode is composition-independent (each vmapped row is the same
+# math as lm_generate's), which is what makes per-row results bit-identical
+# to lm_generate on the same prompt; sampled rows draw a per-row stream
+# fold_in(key(seed), step) that is ALSO composition-independent — stronger
+# replay than the gang path's shared-batch key.
+
+
+def init_kv_slab(params, rows: int, max_len: int, heads: int,
+                 compute_dtype: str | None = None):
+    """Zeroed persistent KV pool: layer -> (k, v), each (rows, max_len,
+    kv_heads, dh) in the compute dtype — one slot per row, sized for one
+    bucket (max_len = P_bucket + steps_bucket). The slab is allocated once
+    per (bucket, engine) and then only ever updated in place through the
+    donated prefill/decode programs below."""
+    d = params["emb"].shape[1]
+    dh = d // heads
+    kvh = params["l0"]["wk"].shape[1] // dh  # kv_heads <= heads under GQA
+    dt = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
+    return {f"l{i}": tuple(jnp.zeros((rows, max_len, kvh, dh), dt)
+                           for _ in range(2))
+            for i in range(_n_layers(params))}
+
+
+def _pick_token_row(temperature, top_p, top_k, logits, sub):
+    """Per-row sampling where every knob is a TRACED scalar (so one decode
+    program serves any per-row mix): temperature 0 selects greedy argmax,
+    ``top_k`` 0 disables the rank filter, ``top_p`` 1.0 disables the nucleus
+    filter. Differences from the static-knob :func:`_pick_tokens`: top-k is
+    by rank (exactly k survivors; value ties at the k-th logit break by sort
+    order instead of all surviving), and the sort always exists in the
+    program — per-row knobs cannot statically elide it. The greedy branch is
+    the same argmax, so greedy rows are unaffected by either."""
+
+    def sample():
+        l = logits / jnp.maximum(temperature, 1e-6)
+        order = jnp.argsort(-l)  # stable: first max stays first
+        srt = jnp.take_along_axis(l, order, -1)
+        ranks = jnp.arange(l.shape[-1])
+        srt = jnp.where(jnp.where(top_k > 0, ranks < top_k, True),
+                        srt, -jnp.inf)
+        probs = jax.nn.softmax(srt, axis=-1)
+        keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p  # exclusive mass
+        keep = keep.at[..., 0].set(True)  # top_p -> 0 must mean greedy
+        srt = jnp.where(keep, srt, -jnp.inf)
+        inv = jnp.argsort(order)
+        return jax.random.categorical(
+            sub, jnp.take_along_axis(srt, inv, -1)).astype(jnp.int32)
+
+    return jax.lax.cond(
+        temperature > 0.0, sample,
+        lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32))
+
+
+def _row_key(seed, step):
+    """The per-row sampling stream: fold the emitted-token index into the
+    row's seed key. Depends only on (seed, step) — never on slot index or
+    co-resident rows — so sampled replay is composition-independent."""
+    return jax.random.fold_in(jax.random.key(seed), step)
+
+
+def lm_prefill_slot(params, caches, tokens, slot, prompt, length, heads: int,
+                    max_len: int, seed=0, temperature=0.0, top_p=None,
+                    top_k=None, compute_dtype: str | None = None,
+                    moe: tuple | None = None):
+    """Prefill one prompt into slot ``slot`` of a persistent KV slab.
+
+    ``caches``/``tokens`` are the slab state from :func:`init_kv_slab` /
+    a (rows, max_len) int32 token buffer — both are DONATED (the update is
+    in place; the caller must replace its references with the returned
+    arrays). ``prompt`` is (P,) int32 padded to the bucket width, ``length``
+    its true length; the program writes the slot's full cache row (stale
+    K/V from a previous occupant is fully overwritten), stores
+    ``prompt + first_token`` into the slot's token row, and returns
+    ``(caches, tokens, first_token)``. One compile per (P, max_len) bucket
+    shape — ``slot``/``length``/sampling knobs are all traced."""
+    return _lm_prefill_slot_jit(
+        params, caches, tokens, jnp.asarray(slot, jnp.int32),
+        jnp.asarray(prompt, jnp.int32), jnp.asarray(length, jnp.int32),
+        jnp.asarray(seed, jnp.uint32),
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+        jnp.asarray(0 if top_k is None else top_k, jnp.int32),
+        heads=heads, max_len=max_len, compute_dtype=compute_dtype, moe=moe)
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "max_len",
+                                             "compute_dtype", "moe"),
+                   donate_argnums=(1, 2))
+def _lm_prefill_slot_jit(params, caches, tokens, slot, prompt, length,
+                         seed, temperature, top_p, top_k, heads: int,
+                         max_len: int, compute_dtype, moe=None):
+    P = prompt.shape[0]
+    if P + 1 > max_len:
+        raise ValueError(f"bucket prompt width {P} leaves no room for a "
+                         f"generated token within max_len {max_len}")
+    cdtype = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
+    x, row_caches = _prefill_hidden(params, prompt, heads, max_len, cdtype,
+                                    moe)
+    # causal attention: positions < length never see the pad tail, so the
+    # hidden state at length-1 equals the unpadded prompt's last position
+    logits0 = _head_logits(x[length - 1], params["emb"])
+    first = _pick_token_row(temperature, top_p, top_k, logits0,
+                            _row_key(seed, 0))
+    row_tokens = (jnp.zeros((max_len,), jnp.int32)
+                  .at[:P].set(prompt).at[length].set(first))
+    new_caches = {
+        name: tuple(jax.lax.dynamic_update_index_in_dim(slab, row, slot, 0)
+                    for slab, row in zip(caches[name], row_caches[name]))
+        for name in caches}
+    tokens = jax.lax.dynamic_update_index_in_dim(tokens, row_tokens, slot, 0)
+    return new_caches, tokens, first
+
+
+def lm_decode_rows(params, caches, tokens, positions, steps_done, seeds,
+                   temperature, top_p, top_k, heads: int, max_len: int,
+                   compute_dtype: str | None = None,
+                   moe: tuple | None = None):
+    """One decode step for EVERY slot of a persistent KV slab.
+
+    ``caches``/``tokens`` are the slab state (DONATED — replace your
+    references with the returned arrays). Per-row vectors, all (rows,):
+    ``positions`` the index of each row's last written token (free slots
+    pass 0 — they compute a masked-harmless dummy step whose outputs the
+    scheduler ignores), ``steps_done`` the emitted-token count feeding the
+    per-row sampling stream, ``seeds``/``temperature``/``top_p``/``top_k``
+    the per-row sampling knobs (0 temperature = greedy; ``top_p`` 1.0 /
+    ``top_k`` 0 = off). Writes each row's next token at ``positions + 1``
+    (the caller guarantees ``positions + 1 < max_len`` for live rows) and
+    returns ``(caches, tokens, next_tokens)``. One compile per bucket —
+    the second of the two row-level programs."""
+    as_i32 = lambda v: jnp.asarray(v, jnp.int32)  # noqa: E731
+    return _lm_decode_rows_jit(
+        params, caches, tokens, as_i32(positions), as_i32(steps_done),
+        jnp.asarray(seeds, jnp.uint32),
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(top_p, jnp.float32), as_i32(top_k),
+        heads=heads, max_len=max_len, compute_dtype=compute_dtype, moe=moe)
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "max_len",
+                                             "compute_dtype", "moe"),
+                   donate_argnums=(1, 2))
+def _lm_decode_rows_jit(params, caches, tokens, positions, steps_done, seeds,
+                        temperature, top_p, top_k, heads: int, max_len: int,
+                        compute_dtype, moe=None):
+    B = tokens.shape[0]
+    rows = jnp.arange(B)
+    cdtype = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
+    # clamp the write index so a free slot (positions 0) scribbles inside
+    # its own row instead of clipping out of bounds; its cache write at
+    # position 0 is equally harmless — prefill rewrites the whole cache row
+    # when the slot is next assigned
+    pos = jnp.minimum(positions, max_len - 2)
+    x = params["emb"][tokens[rows, pos]].astype(cdtype)
+    logits, caches = jax.vmap(
+        lambda xb, cb, pb: _decode_step(params, xb, cb, pb, heads, moe)
+    )(x, caches, pos)
+    subs = jax.vmap(_row_key)(seeds, steps_done)
+    nxt = jax.vmap(_pick_token_row)(temperature, top_p, top_k, logits, subs)
+    tokens = tokens.at[rows, pos + 1].set(nxt)
+    return caches, tokens, nxt
+
+
 # forward the private jit cache-size probe through the un-jitted shims (the
 # no-recompile tests/benches read it; getattr-guarded everywhere, so its
 # absence on a future JAX merely skips those checks)
 for _pub, _jit in ((lm_generate, _lm_generate_jit),
-                   (lm_generate_batch, _lm_generate_batch_jit)):
+                   (lm_generate_batch, _lm_generate_batch_jit),
+                   (lm_prefill_slot, _lm_prefill_slot_jit),
+                   (lm_decode_rows, _lm_decode_rows_jit)):
     if hasattr(_jit, "_cache_size"):
         _pub._cache_size = _jit._cache_size
 del _pub, _jit

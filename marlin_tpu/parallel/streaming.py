@@ -37,6 +37,32 @@ from .prefetch import ChunkPrefetcher
 __all__ = ["streamed_matmul", "streamed_gramian", "iter_row_chunks"]
 
 
+# Module-level jits shared by every streamed call: a per-call `@jax.jit`
+# closure is a fresh cache per invocation, so each streamed op would
+# recompile its chunk programs EVERY time (found by the compile-count guard
+# in tests/test_prefetch.py). Hoisted here, repeated streaming over the same
+# chunk geometry hits one compiled program per shape, process-wide.
+
+def _chunk_mm_impl(x, b_dev, precision):
+    # re-expand compressed uploads without ever *down*-casting: promote to
+    # the wider of the two dtypes (f32 a × bf16 b stays f32; bf16 uploads
+    # widen to b's dtype)
+    return jnp.dot(x.astype(jnp.promote_types(x.dtype, b_dev.dtype)), b_dev,
+                   precision=precision)
+
+
+_chunk_mm = jax.jit(_chunk_mm_impl, static_argnames=("precision",))
+
+
+def _gram_accumulate_impl(g, x, precision):
+    x = x.astype(g.dtype)
+    return g + jnp.dot(x.T, x, precision=precision)
+
+
+_gram_accumulate = jax.jit(_gram_accumulate_impl,
+                           static_argnames=("precision",))
+
+
 def iter_row_chunks(a, chunk_rows: int) -> Iterator[np.ndarray]:
     """Yield row chunks from an ndarray/memmap (zero-copy views)."""
     for start in range(0, a.shape[0], chunk_rows):
@@ -116,13 +142,8 @@ def streamed_matmul(
     stats = stats if stats is not None else StageTimes()
     b_dev = jnp.asarray(b.logical() if hasattr(b, "logical") else b)
 
-    @jax.jit
     def chunk_mm(x):
-        # re-expand compressed uploads without ever *down*-casting: promote to
-        # the wider of the two dtypes (f32 a × bf16 b stays f32; bf16 uploads
-        # widen to b's dtype)
-        return jnp.dot(x.astype(jnp.promote_types(x.dtype, b_dev.dtype)), b_dev,
-                       precision=precision)
+        return _chunk_mm(x, b_dev, precision)
 
     results, offset, pending, saw_chunk = [], 0, [], False
 
@@ -191,10 +212,8 @@ def streamed_gramian(
     precision = precision or get_config().matmul_precision
     stats = stats if stats is not None else StageTimes()
 
-    @jax.jit
     def accumulate(g, x):
-        x = x.astype(dtype)
-        return g + jnp.dot(x.T, x, precision=precision)
+        return _gram_accumulate(g, x, precision)
 
     g = None
     # with no explicit transfer dtype, upload in the accumulation dtype (the

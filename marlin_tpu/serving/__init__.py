@@ -19,6 +19,7 @@ Quick start::
 
 from .batcher import (  # noqa: F401
     BatchFormer,
+    SlotPool,
     aot_compile_buckets,
     bucket_kv_bytes,
     normalize_buckets,

@@ -25,17 +25,29 @@ fresh multi-second compile. The discipline here:
   (:mod:`marlin_tpu.utils.aot` — no chip needed) and returns the compiler's
   per-bucket peak-HBM accounting, the offline sizing channel for
   ``serve_buckets`` / ``serve_max_batch``.
+
+Row-level mode (``serve_rowlevel``, the default) keeps the buckets and the
+admission cost model but swaps the dispatch unit: :class:`SlotPool` tracks a
+persistent device-resident KV slab of ``max_batch`` slots per bucket,
+:meth:`BatchFormer.take_for_bucket` hands freed slots the best pending
+request immediately (prefill-on-admit — no ``max_wait`` ripening, no
+sampling-knob grouping: the decode-step program takes per-row traced
+knobs), and warmup/AOT compile exactly TWO programs per bucket (slot
+prefill + single-token decode step).
 """
 
 from __future__ import annotations
 
 import collections
+import heapq
+import itertools
 from typing import Iterable, Sequence
 
 import numpy as np
 
 __all__ = ["normalize_buckets", "pick_bucket", "bucket_kv_bytes",
-           "BatchFormer", "warmup_buckets", "aot_compile_buckets"]
+           "BatchFormer", "SlotPool", "warmup_buckets",
+           "aot_compile_buckets"]
 
 Bucket = tuple[int, int]  # (P_bucket, steps_bucket)
 
@@ -73,7 +85,11 @@ def bucket_kv_bytes(params: dict, heads: int, bucket: Bucket,
     decode working set is layers x 2 x max_len x kv_heads x dh in the compute
     dtype, and max_len = P + steps. This is the admission-control cost model
     — the cache IS the decode memory (models/transformer.py), so bounding the
-    summed row cost bounds what a burst of admissions can pin in HBM."""
+    summed row cost bounds what a burst of admissions can pin in HBM. The
+    charge is taken at admission (reserving the slot the request WILL
+    occupy) and must be released on every retirement path — ok, expired,
+    error, shutting_down — or admission wedges permanently
+    (tests/test_serving.py guards this)."""
     import jax.numpy as jnp
 
     from ..models.transformer import _n_layers
@@ -178,6 +194,106 @@ class BatchFormer:
             out.extend(g.take(len(g.entries)))
         return out
 
+    # ---- row-level claiming (serve_rowlevel): slots admit individually, so
+    # the gang machinery above (sampling-knob grouping, max_wait ripening)
+    # does not apply — the decode-step program takes per-row traced sampling
+    # knobs and every row draws its own stream, so ANY mix shares a step.
+
+    def pending_buckets(self) -> set:
+        """Buckets that currently have pending entries (row-level scheduler:
+        which slot pools might claim work this iteration)."""
+        return {key[0] for key, g in self._groups.items() if g.entries}
+
+    def take_for_bucket(self, bucket: Bucket, n: int) -> list:
+        """Up to ``n`` entries bound for ``bucket``, merged across every
+        sampling group in dispatch order (higher priority first, FIFO among
+        equals) — the prefill-on-admit path: a freed slot takes the best
+        pending request immediately, no max_wait ripening. Each group's list
+        is already sorted by its (-priority, seq) tuples (``_Group.add``),
+        so a k-way heap merge preserves that one ordering rule instead of
+        duplicating the comparator here; ``seq`` is globally unique, so the
+        tuple comparison never reaches the entry itself."""
+        groups = [g for key, g in self._groups.items()
+                  if key[0] == bucket and g.entries]
+        taken = list(itertools.islice(
+            heapq.merge(*(g.entries for g in groups)), n))
+        take_ids = {id(t) for t in taken}
+        for g in groups:
+            g.entries = [t for t in g.entries if id(t) not in take_ids]
+        return [e for _, _, e in taken]
+
+
+class SlotPool:
+    """Slot bookkeeping for one bucket's persistent KV slab (row-level
+    scheduling, docs/serving.md): which slot holds which entry, the per-row
+    vectors the decode-step program takes (positions, emitted-step counts,
+    sampling knobs), and the device-resident ``caches``/``tokens`` slab
+    state itself (:func:`~marlin_tpu.models.transformer.init_kv_slab`; the
+    engine replaces both references after every donated prefill/decode
+    call). Single-threaded — only the engine worker touches a pool."""
+
+    def __init__(self, params: dict, heads: int, bucket: Bucket, width: int,
+                 compute_dtype: str | None = None):
+        import jax.numpy as jnp
+
+        from ..models.transformer import init_kv_slab
+
+        p, s = bucket
+        self.bucket = bucket
+        self.width = width
+        self.max_len = p + s
+        self.caches = init_kv_slab(params, width, self.max_len, heads,
+                                   compute_dtype)
+        self.tokens = jnp.zeros((width, self.max_len), jnp.int32)
+        self.entries: list = [None] * width
+        # decode-program inputs; free slots keep position 0 (a harmless
+        # dummy step inside their own row — see lm_decode_rows)
+        self.positions = np.zeros(width, np.int32)
+        self.steps_done = np.zeros(width, np.int32)
+        self.lengths = np.zeros(width, np.int32)
+        self.seeds = np.zeros(width, np.uint32)
+        self.temperature = np.zeros(width, np.float32)
+        self.top_p = np.ones(width, np.float32)   # 1.0 = nucleus filter off
+        self.top_k = np.zeros(width, np.int32)    # 0 = rank filter off
+        self.ttft_s = [None] * width
+
+    def live_slots(self) -> list[int]:
+        return [i for i, e in enumerate(self.entries) if e is not None]
+
+    def free_slots(self) -> list[int]:
+        return [i for i, e in enumerate(self.entries) if e is None]
+
+    def occupancy(self) -> float:
+        return len(self.live_slots()) / self.width
+
+    def assign(self, slot: int, entry) -> None:
+        """Bind an admitted entry to a freed slot: after the slot's prefill
+        lands, the row's position is its first emitted token (= prompt
+        length) and its sampling vectors come from the request."""
+        r = entry.request
+        self.entries[slot] = entry
+        n = r.prompt.shape[0]
+        self.lengths[slot] = n
+        self.positions[slot] = n          # index of the last written token
+        self.steps_done[slot] = 1         # prefill emitted the first token
+        self.seeds[slot] = np.uint32(r.seed)
+        self.temperature[slot] = r.temperature
+        self.top_p[slot] = 1.0 if r.top_p is None else r.top_p
+        self.top_k[slot] = 0 if r.top_k is None else r.top_k
+        self.ttft_s[slot] = None
+
+    def release(self, slot: int) -> None:
+        """Free a slot on ANY retirement path (the stale cache/token row is
+        fully overwritten by the next occupant's prefill)."""
+        self.entries[slot] = None
+        self.positions[slot] = 0
+        self.steps_done[slot] = 0
+        self.lengths[slot] = 0
+        self.temperature[slot] = 0.0
+        self.top_p[slot] = 1.0
+        self.top_k[slot] = 0
+        self.ttft_s[slot] = None
+
 
 def _dummy_batch(bucket: Bucket, batch: int):
     """An inert full-width batch for a bucket: 1-token rows of token 0."""
@@ -189,24 +305,48 @@ def _dummy_batch(bucket: Bucket, batch: int):
 
 def warmup_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
                    max_batch: int, compute_dtype: str | None = None,
-                   moe: tuple | None = None) -> int:
-    """Compile (and execute once, on dummy rows) the full-width batch program
-    of every bucket, so the first real request never pays the compile.
-    Returns the number of buckets warmed. Greedy, top_p/top_k off — the
-    default-sampling program; a float top_p or a top_k adds its own variant
-    on first use (docs/serving.md)."""
+                   moe: tuple | None = None,
+                   rowlevel: bool | None = None) -> int:
+    """Compile (and execute once, on dummy rows) every bucket's programs, so
+    the first real request never pays the compile. ``rowlevel`` defaults
+    from ``config.serve_rowlevel``, matching what an all-default engine
+    runs: gang mode warms the one fused full-width batch program per
+    bucket; row-level warms the TWO programs per bucket — slot-targeted
+    prefill and the single-token decode step over a throwaway slab.
+    Returns the number of buckets warmed. Greedy/default-sampling programs
+    in gang mode (a float top_p or a top_k adds its own variant on first
+    use); row-level sampling knobs are per-row traced, so the two programs
+    are the whole compile story (docs/serving.md)."""
     import jax
 
+    from ..config import get_config
     from ..models.transformer import lm_generate_batch
 
+    if rowlevel is None:
+        rowlevel = get_config().serve_rowlevel
     buckets = normalize_buckets(buckets)
     for bucket in buckets:
         p, s = bucket
         prompts, lengths = _dummy_batch(bucket, max_batch)
-        out = lm_generate_batch(params, prompts, lengths, jax.random.key(0),
-                                heads=heads, max_len=p + s, steps=s,
-                                compute_dtype=compute_dtype, moe=moe)
-        jax.block_until_ready(out)
+        if rowlevel:
+            from ..models.transformer import lm_decode_rows, lm_prefill_slot
+
+            pool = SlotPool(params, heads, bucket, max_batch, compute_dtype)
+            caches, tokens, _ = lm_prefill_slot(
+                params, pool.caches, pool.tokens, 0, prompts[0], 1,
+                heads=heads, max_len=p + s, compute_dtype=compute_dtype,
+                moe=moe)
+            caches, tokens, nxt = lm_decode_rows(
+                params, caches, tokens, pool.positions, pool.steps_done,
+                pool.seeds, pool.temperature, pool.top_p, pool.top_k,
+                heads=heads, max_len=p + s, compute_dtype=compute_dtype,
+                moe=moe)
+            jax.block_until_ready(nxt)
+        else:
+            out = lm_generate_batch(
+                params, prompts, lengths, jax.random.key(0), heads=heads,
+                max_len=p + s, steps=s, compute_dtype=compute_dtype, moe=moe)
+            jax.block_until_ready(out)
     return len(buckets)
 
 
@@ -225,24 +365,39 @@ def _peak_bytes(ma) -> int:
 def aot_compile_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
                         max_batch: int, compute_dtype: str | None = None,
                         moe: tuple | None = None,
-                        topology_name: str = "v5e:2x2") -> dict[Bucket, int]:
-    """Compile every bucket's batch program against a compile-only TPU
+                        topology_name: str = "v5e:2x2",
+                        rowlevel: bool | None = None) -> dict[Bucket, int]:
+    """Compile every bucket's program(s) against a compile-only TPU
     topology (no chip; :mod:`marlin_tpu.utils.aot`) and return
     ``{bucket: peak_hbm_bytes}`` from the compiler's own accounting — the
     offline evidence for sizing ``serve_buckets`` x ``serve_max_batch``
     against :func:`~marlin_tpu.models.planner.usable_hbm_bytes` (the same
-    budget the admission gate enforces at runtime). Requires libtpu
-    (:func:`~marlin_tpu.utils.aot.supports_aot_tpu`). Peak accounting
-    degrades to the temp+argument+output lower bound on PJRT builds whose
-    stats object lacks ``peak_memory_in_bytes`` (:func:`_peak_bytes`)."""
+    budget the admission gate enforces at runtime). ``rowlevel`` defaults
+    from ``config.serve_rowlevel`` — the same scheduler an all-default
+    :class:`~.engine.ServeEngine` will actually run. Gang mode compiles the
+    fused batch program; row-level compiles BOTH programs (slot prefill +
+    decode step) and reports the larger peak. NOTE the row-level sizing
+    rule differs from gang: every bucket's persistent slab stays device-
+    resident simultaneously (the engine never frees a pool), so steady-
+    state HBM is the SUM over buckets of ``bucket_kv_bytes(...,
+    batch=max_batch)`` plus the largest per-bucket program peak reported
+    here — not the largest bucket alone (docs/serving.md, bucket tuning).
+    Requires libtpu (:func:`~marlin_tpu.utils.aot.supports_aot_tpu`). Peak
+    accounting degrades to the temp+argument+output lower bound on PJRT
+    builds whose stats object lacks ``peak_memory_in_bytes``
+    (:func:`_peak_bytes`)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
 
-    from ..config import config_context
-    from ..models.transformer import _lm_generate_batch_jit
+    from ..config import config_context, get_config
+    from ..models.transformer import (_lm_decode_rows_jit,
+                                      _lm_generate_batch_jit,
+                                      _lm_prefill_slot_jit, init_kv_slab)
     from ..utils.aot import topology_mesh
 
+    if rowlevel is None:
+        rowlevel = get_config().serve_rowlevel
     mesh = topology_mesh(("rows",), (1,), topology_name=topology_name)
     rep = NamedSharding(mesh, PartitionSpec())
 
@@ -251,20 +406,44 @@ def aot_compile_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
             lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
                                            sharding=rep), tree)
 
+    def st(shape, dtype=jnp.int32):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
+
     out = {}
     for bucket in normalize_buckets(buckets):
         p, s = bucket
-        args = (sds(params),
-                jax.ShapeDtypeStruct((max_batch, p), jnp.int32, sharding=rep),
-                jax.ShapeDtypeStruct((max_batch,), jnp.int32, sharding=rep),
-                sds(jax.eval_shape(jax.random.key, 0)),
-                jax.ShapeDtypeStruct((), jnp.float32, sharding=rep),
-                jax.ShapeDtypeStruct((), jnp.float32, sharding=rep))
         with config_context(pallas_interpret=False):
-            compiled = _lm_generate_batch_jit.trace(
-                *args[:4], heads=heads, max_len=p + s, steps=s,
-                temperature=args[4], compute_dtype=compute_dtype,
-                top_p=args[5], use_top_p=False, top_k=None,
-                moe=moe).lower().compile()
-        out[bucket] = _peak_bytes(compiled.memory_analysis())
+            if rowlevel:
+                # derive the slab structs from init_kv_slab itself (the one
+                # source of truth for the layout) instead of re-deriving
+                # d/dh/kvh by hand — a layout change there cannot silently
+                # diverge from what this tool sizes
+                caches = sds(jax.eval_shape(
+                    lambda pp: init_kv_slab(pp, max_batch, p + s, heads,
+                                            compute_dtype), params))
+                tokens = st((max_batch, p + s))
+                pre = _lm_prefill_slot_jit.trace(
+                    sds(params), caches, tokens, st(()), st((p,)), st(()),
+                    st((), jnp.uint32), st((), jnp.float32),
+                    st((), jnp.float32), st(()), heads=heads, max_len=p + s,
+                    compute_dtype=compute_dtype, moe=moe).lower().compile()
+                dec = _lm_decode_rows_jit.trace(
+                    sds(params), caches, tokens, st((max_batch,)),
+                    st((max_batch,)), st((max_batch,), jnp.uint32),
+                    st((max_batch,), jnp.float32),
+                    st((max_batch,), jnp.float32), st((max_batch,)),
+                    heads=heads, max_len=p + s, compute_dtype=compute_dtype,
+                    moe=moe).lower().compile()
+                out[bucket] = max(_peak_bytes(pre.memory_analysis()),
+                                  _peak_bytes(dec.memory_analysis()))
+            else:
+                args = (sds(params), st((max_batch, p)), st((max_batch,)),
+                        sds(jax.eval_shape(jax.random.key, 0)),
+                        st((), jnp.float32), st((), jnp.float32))
+                compiled = _lm_generate_batch_jit.trace(
+                    *args[:4], heads=heads, max_len=p + s, steps=s,
+                    temperature=args[4], compute_dtype=compute_dtype,
+                    top_p=args[5], use_top_p=False, top_k=None,
+                    moe=moe).lower().compile()
+                out[bucket] = _peak_bytes(compiled.memory_analysis())
     return out

@@ -1,37 +1,53 @@
 """The continuous-batching serving engine: one worker thread, compiled decode.
 
 :class:`ServeEngine` is the front half of an inference stack over the
-library's batched decode (:func:`~marlin_tpu.models.transformer
-.lm_generate_batch`, "the serving shape"): concurrent callers ``submit``
-requests; an admission gate (queue depth + in-flight KV-cache HBM budget,
-request.py) rejects overload with a reason; a batch former (batcher.py)
-buckets prompts onto a small static shape set so each bucket compiles ONCE;
-and a single worker thread runs the continuous loop —
+library's compiled decode programs: concurrent callers ``submit`` requests;
+an admission gate (queue depth + in-flight KV-cache HBM budget, request.py)
+rejects overload with a reason; a batch former (batcher.py) buckets prompts
+onto a small static shape set so compiles stay bounded; and a single worker
+thread keeps the device fed. Two schedulers share that skeleton:
 
-    claim a batch of slots  →  retire deadline-expired rows  →  prefill the
-    live rows + run the bucket's compiled decode program (one fused XLA
-    program per bucket)  →  retire finished rows with Results  →  repeat
+**Row-level** (``serve_rowlevel``, the default) changes the unit of
+scheduling from "batch" to "slot-step". Each bucket owns a persistent
+device-resident KV slab of ``max_batch`` slots (:class:`~.batcher.SlotPool`)
+and TWO compiled programs — slot-targeted prefill
+(:func:`~marlin_tpu.models.transformer.lm_prefill_slot`) and a single-token
+decode step over the whole slab
+(:func:`~marlin_tpu.models.transformer.lm_decode_rows`, donated KV buffers,
+per-row positions and sampling knobs). Every worker iteration:
 
-Scheduling is gang-style: the ``max_batch`` slot rows of one bucket launch
-and land together (free slots carry inert dummy rows so the batch shape —
-and therefore the compiled program — never varies). That trades some
-tail-row latency for two hard guarantees the acceptance tests assert: a
-bounded compile count (≤ one program per bucket for default sampling) and
-bit-identical outputs to calling ``lm_generate_batch`` directly on the same
-bucket shape. Row-level continuous batching (admitting into a running
-batch's free slots mid-decode) is the documented next step
-(docs/serving.md).
+    refill freed slots from the queue (prefill-on-admit; the prompt's
+    first token lands here — real TTFT)  →  retire rows that emitted
+    their ``eos``, hit their step budget, or expired  →  run ONE decode
+    step for all live rows  →  repeat
 
-Lifecycle: ``drain()`` stops admission and completes everything already
-accepted (partial batches dispatch immediately rather than waiting out
-``max_wait``); ``close()`` stops admission, finishes the batch in flight,
-and retires everything still queued with a clean ``shutting_down`` Result.
-Both are terminal and idempotent; the worker thread (named
-``marlin-serve-*`` — the conftest leak fixture watches the prefix) is joined
-before either returns. Chaos hooks: ``serve.enqueue`` fires in ``submit``,
-``serve.step`` fires before each batch launch (utils/faults.py) — a fault
-there fails that batch's requests with ``error`` Results and the engine
-keeps serving.
+A finished row's slot refills on the very next step instead of riding out
+its batch as a dummy, and a newly admitted request waits one step, not one
+whole batch — the tokens/s and TTFT win at high offered load. Per-row
+greedy output stays bit-identical to :func:`~marlin_tpu.models.transformer
+.lm_generate` on the same prompt (greedy decode is composition-independent)
+and the compile count is ≤ 2 programs per bucket, for ANY per-row mix of
+sampling knobs (they are traced vectors).
+
+**Gang** (``serve_rowlevel=False``, the fallback) runs one fused
+``lm_generate_batch`` program per bucket to completion: all ``max_batch``
+slot rows launch and land together (free slots carry inert dummy rows).
+Simpler — one program per bucket, no per-step host sync — but a finished
+row holds its slot as a dummy until the whole batch lands, and admissions
+wait out the entire in-flight batch.
+
+Lifecycle (both schedulers): ``drain()`` stops admission and completes
+everything already accepted; ``close()`` stops admission, finishes the work
+in flight (the gang batch / the live slots), and retires everything still
+queued with a clean ``shutting_down`` Result. Both are terminal and
+idempotent; the worker thread (named ``marlin-serve-*`` — the conftest leak
+fixture watches the prefix) is joined before either returns. Chaos hooks
+(utils/faults.py): ``serve.enqueue`` fires in ``submit``; ``serve.step``
+fires before each gang batch launch / each row-level prefill — a fault
+fails those requests with ``error`` Results; ``serve.decode_step`` fires
+before each row-level decode step — a fault there fails only that step's
+live rows and leaves the slot pool consistent. The engine keeps serving
+after any of them.
 """
 
 from __future__ import annotations
@@ -63,9 +79,11 @@ _POLL_CAP_S = 0.02
 
 
 class _Entry:
-    """One admitted request riding through the former to a batch slot."""
+    """One admitted request riding through the former to a batch slot.
+    ``queue_s`` is stamped when the row-level scheduler claims the entry
+    for a slot (the gang path derives it at dispatch instead)."""
 
-    __slots__ = ("request", "handle", "bucket", "cost", "enq_t")
+    __slots__ = ("request", "handle", "bucket", "cost", "enq_t", "queue_s")
 
     def __init__(self, request, handle, bucket, cost, enq_t):
         self.request = request
@@ -73,6 +91,7 @@ class _Entry:
         self.bucket = bucket
         self.cost = cost
         self.enq_t = enq_t
+        self.queue_s = None
 
 
 class ServeEngine:
@@ -89,6 +108,11 @@ class ServeEngine:
     tests; wall throughput is always measured on the real clock. ``log``
     overrides the default EventLog for ``serve`` records.
 
+    ``rowlevel`` picks the scheduler (``serve_rowlevel`` by default): True =
+    slot-step scheduling over persistent per-bucket KV slabs (prefill +
+    decode-step programs, per-row retirement/refill); False = the gang
+    fallback (one fused program per bucket runs a batch to completion).
+
     Usable as a context manager (``close()`` on exit); ``start=False`` defers
     the worker thread so tests can stage a queue before any dispatch."""
 
@@ -98,12 +122,15 @@ class ServeEngine:
                  queue_depth: int | None = None,
                  hbm_budget_bytes: int | None = None,
                  compute_dtype: str | None = None, moe: tuple | None = None,
+                 rowlevel: bool | None = None,
                  clock=time.monotonic, log=None, start: bool = True):
         cfg = get_config()
         self.params = params
         self.heads = heads
         self.compute_dtype = compute_dtype
         self.moe = moe
+        self.rowlevel = bool(cfg.serve_rowlevel if rowlevel is None
+                             else rowlevel)
         self.buckets = normalize_buckets(
             cfg.serve_buckets if buckets is None else buckets)
         self.max_batch = int(cfg.serve_max_batch if max_batch is None
@@ -141,10 +168,12 @@ class ServeEngine:
         self._thread.start()
 
     def warmup(self) -> int:
-        """Compile every bucket's full-width batch program before traffic
-        (one dummy execution per bucket; see batcher.warmup_buckets)."""
+        """Compile every bucket's program(s) before traffic — the fused
+        batch program per bucket in gang mode, the prefill + decode-step
+        pair per bucket in row-level mode (batcher.warmup_buckets)."""
         return warmup_buckets(self.params, self.heads, self.buckets,
-                              self.max_batch, self.compute_dtype, self.moe)
+                              self.max_batch, self.compute_dtype, self.moe,
+                              rowlevel=self.rowlevel)
 
     def pending(self) -> int:
         """Requests admitted but not yet retired (queued + in flight)."""
@@ -245,6 +274,12 @@ class ServeEngine:
     # ----------------------------------------------------------- worker loop
 
     def _run(self) -> None:
+        if self.rowlevel:
+            self._run_rowlevel()
+        else:
+            self._run_gang()
+
+    def _run_gang(self) -> None:
         inflight = []
         try:
             while True:
@@ -294,7 +329,247 @@ class ServeEngine:
         self.metrics.record_result(
             result.rid, result.status, bucket=result.metrics.get("bucket"),
             queue_s=result.metrics.get("queue_s"),
-            total_s=result.metrics.get("total_s"))
+            total_s=result.metrics.get("total_s"),
+            ttft_s=result.metrics.get("ttft_s"))
+
+    # ------------------------------------------------- row-level scheduler
+
+    def _run_rowlevel(self) -> None:
+        """The slot-step loop: each iteration refills freed slots from the
+        queue (prefill-on-admit), retires finished/expired rows, and runs
+        one decode step per bucket with live rows. ``pools`` maps bucket ->
+        SlotPool and persists across iterations — the KV slab never leaves
+        the device between steps."""
+        pools: dict[tuple, object] = {}
+        claimed: list[_Entry] = []
+        try:
+            while True:
+                claimed = []
+                with self._cond:
+                    while True:
+                        if self._state == "closing":
+                            # the live slots are the work in flight: finish
+                            # them (close() already emptied the former)
+                            if not any(p.live_slots()
+                                       for p in pools.values()):
+                                return
+                            break
+                        draining = self._state == "draining"
+                        claimed = self._claim_rowlevel(pools)
+                        if claimed or any(p.live_slots()
+                                          for p in pools.values()):
+                            break
+                        if draining:
+                            return  # nothing queued, nothing live
+                        # no max_wait ripening in row-level mode: wait for
+                        # a submit/drain/close notify (poll-capped under an
+                        # injected clock, as in the gang loop)
+                        self._cond.wait(None if self._real_clock
+                                        else _POLL_CAP_S)
+                self._admit_rowlevel(pools, claimed)
+                claimed = []
+                self._step_rowlevel(pools)
+        except BaseException:  # pragma: no cover - scheduler invariant
+            # as in the gang loop: a dying worker fails everything it was
+            # holding — claimed-but-unslotted entries, live slots, and the
+            # still-queued backlog — so no submitter is stranded
+            with self._cond:
+                leftovers = self._former.take_all()
+                self._state = "closing"
+            live = [p.entries[i] for p in pools.values()
+                    for i in p.live_slots()]
+            for e in leftovers + claimed + live:
+                if not e.handle.done():
+                    self._retire(e, Result(e.request.rid, STATUS_ERROR,
+                                           reason="serving worker died"))
+            raise
+
+    def _claim_rowlevel(self, pools) -> list[_Entry]:
+        """Claim queued entries for free slots, per bucket (called under the
+        engine lock; prefill happens outside it)."""
+        claimed = []
+        for bucket in self._former.pending_buckets():
+            pool = pools.get(bucket)
+            free = self.max_batch if pool is None \
+                else len(pool.free_slots())
+            if free:
+                claimed.extend(self._former.take_for_bucket(bucket, free))
+        return claimed
+
+    def _admit_rowlevel(self, pools, claimed) -> None:
+        """Prefill each claimed entry into a free slot of its bucket's pool
+        (created lazily). The first token lands here — the row's TTFT."""
+        from .batcher import SlotPool
+        from ..models.transformer import lm_prefill_slot
+
+        for e in claimed:
+            now = self._clock()
+            r = e.request
+            dl = r.deadline
+            p, s = e.bucket
+            if dl is not None and dl <= now:
+                self._retire(e, Result(
+                    r.rid, STATUS_EXPIRED,
+                    reason=f"deadline {dl} passed before dispatch "
+                           f"(dispatched at {now})",
+                    metrics={"bucket": e.bucket, "queue_s": now - e.enq_t,
+                             "total_s": now - e.enq_t}))
+                continue
+            e.queue_s = now - e.enq_t
+            try:
+                faults.fire("serve.step", path=f"bucket-{p}x{s}")
+                pool = pools.get(e.bucket)
+                if pool is None:
+                    pool = pools[e.bucket] = SlotPool(
+                        self.params, self.heads, e.bucket, self.max_batch,
+                        self.compute_dtype)
+                slot = pool.free_slots()[0]
+                prompt = np.zeros((p,), np.int32)
+                n = r.prompt.shape[0]
+                prompt[:n] = r.prompt
+                t0 = time.perf_counter()
+                caches, tokens, first = lm_prefill_slot(
+                    self.params, pool.caches, pool.tokens, slot, prompt, n,
+                    heads=self.heads, max_len=p + s, seed=r.seed,
+                    temperature=r.temperature, top_p=r.top_p, top_k=r.top_k,
+                    compute_dtype=self.compute_dtype, moe=self.moe)
+                first = int(first)  # device sync: the first token exists
+                wall = time.perf_counter() - t0
+            except Exception as exc:
+                self._admit_failure(pools, e, exc)
+                continue
+            pool.caches, pool.tokens = caches, tokens
+            pool.assign(slot, e)
+            pool.ttft_s[slot] = self._clock() - e.enq_t
+            self.metrics.record_prefill(e.bucket, wall)
+            if r.steps == 1 or (r.eos is not None and first == r.eos):
+                self._retire_row(pool, slot, STATUS_OK, self._clock())
+
+    def _step_rowlevel(self, pools) -> None:
+        """Retire expired live rows, then run ONE decode step per bucket
+        with live rows and retire rows that finished on it. All buckets'
+        step programs are DISPATCHED before any result is awaited — JAX
+        dispatch is async, so bucket B's device work overlaps the host
+        round-trip for bucket A instead of serializing behind it."""
+        from ..models.transformer import lm_decode_rows
+
+        launched = []
+        for bucket, pool in list(pools.items()):
+            now = self._clock()
+            for i in pool.live_slots():
+                dl = pool.entries[i].request.deadline
+                if dl is not None and dl <= now:
+                    self._retire_row(
+                        pool, i, STATUS_EXPIRED, now,
+                        reason=f"deadline {dl} passed mid-decode "
+                               f"(now {now})")
+            live = pool.live_slots()
+            if not live:
+                continue
+            p, s = bucket
+            try:
+                faults.fire("serve.decode_step", path=f"bucket-{p}x{s}")
+                t0 = time.perf_counter()
+                caches, tokens, nxt = lm_decode_rows(
+                    self.params, pool.caches, pool.tokens, pool.positions,
+                    pool.steps_done, pool.seeds, pool.temperature,
+                    pool.top_p, pool.top_k, heads=self.heads,
+                    max_len=pool.max_len, compute_dtype=self.compute_dtype,
+                    moe=self.moe)
+            except Exception as exc:
+                self._fail_pool(pools, bucket, exc)
+                continue
+            pool.caches, pool.tokens = caches, tokens
+            launched.append((bucket, pool, live, t0, nxt))
+        for bucket, pool, live, t0, nxt in launched:
+            try:
+                nxt = np.asarray(nxt)  # sync; the per-row emitted tokens
+            except Exception as exc:
+                self._fail_pool(pools, bucket, exc)
+                continue
+            wall = time.perf_counter() - t0
+            self.metrics.record_step(bucket, len(live), self.max_batch, wall)
+            now = self._clock()
+            host_tokens = None  # one slab fetch shared by this step's retirees
+            for i in live:
+                pool.positions[i] += 1
+                pool.steps_done[i] += 1
+                r = pool.entries[i].request
+                if ((r.eos is not None and int(nxt[i]) == r.eos)
+                        or int(pool.steps_done[i]) >= r.steps):
+                    if host_tokens is None:
+                        host_tokens = np.asarray(pool.tokens)
+                    self._retire_row(pool, i, STATUS_OK, now,
+                                     host_tokens=host_tokens)
+
+    def _retire_row(self, pool, slot: int, status: str, now: float,
+                    reason: str = "", host_tokens=None) -> None:
+        """Retire one slot's row and free the slot — the ONLY path a live
+        slot leaves the pool by, so every terminal status releases the
+        admission budget exactly once. ``host_tokens`` lets a step that
+        retires several rows share ONE slab fetch (the transfer is whole-slab
+        either way: a per-slot device gather would compile one tiny
+        executable per static slot index and break the
+        zero-compiles-under-traffic guarantee)."""
+        e = pool.entries[slot]
+        metrics = {"bucket": pool.bucket, "slot": slot,
+                   "queue_s": e.queue_s, "ttft_s": pool.ttft_s[slot],
+                   "total_s": now - e.enq_t}
+        if status == STATUS_OK:
+            n = int(pool.lengths[slot])
+            emitted = int(pool.steps_done[slot])
+            if host_tokens is None:
+                host_tokens = np.asarray(pool.tokens)
+            toks = host_tokens[slot, : n + emitted].copy()
+            result = Result(e.request.rid, STATUS_OK, tokens=toks,
+                            metrics=metrics)
+        else:
+            result = Result(e.request.rid, status, reason=reason,
+                            metrics=metrics)
+        pool.release(slot)
+        self._retire(e, result)
+
+    def _fail_pool(self, pools, bucket, exc: Exception) -> None:
+        """A decode step died: fail ONLY that step's live rows with error
+        Results and leave the slot pool consistent (slots freed, budget
+        released). If the failed call consumed the donated slab (a genuine
+        post-dispatch failure, not an injected fault raised before launch),
+        drop the pool — it is rebuilt zeroed on the next admission."""
+        pool = pools[bucket]
+        reason = f"decode step failed: {type(exc).__name__}: {exc}"
+        now = self._clock()
+        for i in pool.live_slots():
+            self._retire_row(pool, i, STATUS_ERROR, now, reason=reason)
+        if self._slab_lost(pool):
+            pools.pop(bucket)
+
+    def _admit_failure(self, pools, entry: _Entry, exc: Exception) -> None:
+        """A prefill died: the entry being admitted gets an error Result;
+        co-resident live rows survive unless the failed call consumed the
+        donated slab, in which case they fail too and the pool is dropped."""
+        now = self._clock()
+        reason = f"prefill failed: {type(exc).__name__}: {exc}"
+        self._retire(entry, Result(
+            entry.request.rid, STATUS_ERROR, reason=reason,
+            metrics={"bucket": entry.bucket, "queue_s": entry.queue_s,
+                     "total_s": now - entry.enq_t}))
+        pool = pools.get(entry.bucket)
+        if pool is not None and self._slab_lost(pool):
+            for i in pool.live_slots():
+                self._retire_row(pool, i, STATUS_ERROR, now,
+                                 reason=f"slab lost to a failed prefill: "
+                                        f"{reason}")
+            pools.pop(entry.bucket)
+
+    @staticmethod
+    def _slab_lost(pool) -> bool:
+        """True when a failed donated call consumed the pool's arrays (the
+        backends that implement donation delete the inputs on dispatch;
+        injected faults raise before the call and never trip this)."""
+        deleted = getattr(pool.tokens, "is_deleted", None)
+        return bool(deleted and deleted())
+
+    # ---------------------------------------------------- gang scheduler
 
     def _execute(self, group_key, entries) -> None:
         """One engine cycle: expire stale rows, prefill live rows into the

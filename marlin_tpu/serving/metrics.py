@@ -9,18 +9,29 @@ picked up) under the single kind ``"serve"`` with an ``ev`` discriminator:
 =============  ===========================================================
 ``enqueue``    ``rid``, ``bucket``, ``depth`` (queue depth after admit)
 ``reject``     ``rid``, ``reason``
-``batch``      ``bucket``, ``rows`` (live), ``occupancy`` (live/max_batch),
+``prefill``    row-level scheduling, one per slot prefill: ``bucket``,
+               ``new_tokens`` (1 — the row's first token lands here),
+               ``seconds`` (prefill wall time)
+``batch``      gang scheduling, one per dispatched batch: ``bucket``,
+               ``rows`` (live), ``occupancy`` (live/max_batch),
                ``new_tokens``, ``seconds`` (wall), ``tok_s``
+``step``       row-level scheduling, one per DECODE STEP over the slab:
+               ``bucket``, ``rows`` (live this step), ``occupancy``,
+               ``new_tokens`` (= live rows), ``seconds`` (wall decode-step
+               latency), ``tok_s`` — the per-step occupancy stream is how
+               slot refill is asserted (a finished row's slot shows
+               occupied again on the next step's record)
 ``result``     ``rid``, ``status``, ``bucket``, ``queue_s``, ``ttft_s``,
                ``total_s``
 =============  ===========================================================
 
 Latencies are measured on the engine's *injected* clock (deterministic
 tests), throughput (``tok_s``) on the real wall clock (it is a measurement,
-not a policy input). Under the engine's gang scheduling a row's first token
-becomes visible only when its batch's whole generation program returns, so
-``ttft_s`` equals ``total_s`` today; both are recorded so the contract is
-stable when a streaming decode loop lands (docs/serving.md).
+not a policy input). Under gang scheduling a row's first token becomes
+visible only when its batch's whole generation program returns, so
+``ttft_s`` equals ``total_s`` there; under row-level scheduling the first
+token lands with the slot's prefill, so ``ttft_s`` is genuinely earlier —
+the headline latency the row-level split buys (docs/serving.md).
 
 :meth:`ServeMetrics.snapshot` aggregates everything for tests and the bench
 (`bench_all.py serve`) without re-reading the log file.
@@ -62,11 +73,15 @@ class ServeMetrics:
         self.errors = 0
         self.shut_down = 0
         self.batches = 0
+        self.steps = 0
         self.new_tokens = 0
         self.busy_s = 0.0
         self._occupancy_sum = 0.0
+        self._step_occupancy_sum = 0.0
         self._total_s: list[float] = []
         self._queue_s: list[float] = []
+        self._ttft_s: list[float] = []
+        self._step_s: list[float] = []
 
     def _emit(self, **fields) -> None:
         log = self._log or get_default_event_log()
@@ -95,9 +110,37 @@ class ServeMetrics:
                    new_tokens=new_tokens, seconds=seconds,
                    tok_s=round(new_tokens / max(seconds, 1e-9), 2))
 
+    def record_prefill(self, bucket, seconds: float) -> None:
+        """One row-level slot prefill: the row's FIRST token is emitted here
+        (real TTFT), so it counts toward ``new_tokens``/``busy_s`` — without
+        this, steps=1 traffic would report zero tokens and every request
+        would be undercounted by one versus the gang accounting."""
+        with self._lock:
+            self.new_tokens += 1
+            self.busy_s += seconds
+        self._emit(ev="prefill", bucket=list(bucket), new_tokens=1,
+                   seconds=seconds)
+
+    def record_step(self, bucket, rows: int, max_batch: int,
+                    seconds: float) -> None:
+        """One row-level decode step over a bucket's slab: ``rows`` live
+        slots each emitted one token (``new_tokens`` == ``rows``)."""
+        with self._lock:
+            self.steps += 1
+            self.new_tokens += rows
+            self.busy_s += seconds
+            self._step_occupancy_sum += rows / max_batch
+            if len(self._step_s) < self._keep:
+                self._step_s.append(seconds)
+        self._emit(ev="step", bucket=list(bucket), rows=rows,
+                   occupancy=round(rows / max_batch, 4), new_tokens=rows,
+                   seconds=seconds,
+                   tok_s=round(rows / max(seconds, 1e-9), 2))
+
     def record_result(self, rid: int, status: str, bucket=None,
                       queue_s: float | None = None,
-                      total_s: float | None = None) -> None:
+                      total_s: float | None = None,
+                      ttft_s: float | None = None) -> None:
         with self._lock:
             if status == "ok":
                 self.completed += 1
@@ -111,35 +154,53 @@ class ServeMetrics:
                 self._total_s.append(total_s)
             if queue_s is not None and len(self._queue_s) < self._keep:
                 self._queue_s.append(queue_s)
+            # ttft falls back to total_s ONLY for completed gang results
+            # (their first token really does surface with the whole batch);
+            # expired/error requests never produced a token, and counting
+            # their wait as time-to-first-token would corrupt the headline
+            # percentile the row-level A/B measures
+            if ttft_s is None and status == "ok":
+                ttft_s = total_s
+            if ttft_s is not None and len(self._ttft_s) < self._keep:
+                self._ttft_s.append(ttft_s)
         fields = {"ev": "result", "rid": rid, "status": status}
         if bucket is not None:
             fields["bucket"] = list(bucket)
         if queue_s is not None:
             fields["queue_s"] = queue_s
+        if ttft_s is not None:
+            fields["ttft_s"] = ttft_s
         if total_s is not None:
-            # gang scheduling: the first token surfaces with the whole batch
-            fields["ttft_s"] = total_s
             fields["total_s"] = total_s
         self._emit(**fields)
 
     def snapshot(self) -> dict:
-        """One aggregate dict: counters plus occupancy mean, tokens/s over
-        engine busy time, and p50/p99 total latency (None until data)."""
+        """One aggregate dict: counters plus occupancy mean (over gang
+        batches and row-level decode steps alike), tokens/s over engine busy
+        time, and p50/p99 total / ttft latency (None until data)."""
         with self._lock:
             lat = list(self._total_s)
             qs = list(self._queue_s)
+            tt = list(self._ttft_s)
+            ss = list(self._step_s)
+            dispatches = self.batches + self.steps
+            occ = self._occupancy_sum + self._step_occupancy_sum
             out = {
                 "submitted": self.submitted, "rejected": self.rejected,
                 "expired": self.expired, "completed": self.completed,
                 "errors": self.errors, "shut_down": self.shut_down,
-                "batches": self.batches, "new_tokens": self.new_tokens,
+                "batches": self.batches, "steps": self.steps,
+                "new_tokens": self.new_tokens,
                 "busy_s": round(self.busy_s, 6),
-                "occupancy_mean": (round(self._occupancy_sum / self.batches, 4)
-                                   if self.batches else None),
+                "occupancy_mean": (round(occ / dispatches, 4)
+                                   if dispatches else None),
                 "tok_s": (round(self.new_tokens / self.busy_s, 2)
                           if self.busy_s > 0 else None),
             }
         out["p50_total_s"] = percentile(lat, 50) if lat else None
         out["p99_total_s"] = percentile(lat, 99) if lat else None
         out["p50_queue_s"] = percentile(qs, 50) if qs else None
+        out["p50_ttft_s"] = percentile(tt, 50) if tt else None
+        out["p99_ttft_s"] = percentile(tt, 99) if tt else None
+        out["p50_step_s"] = percentile(ss, 50) if ss else None
         return out

@@ -49,15 +49,26 @@ class Request:
     its batch forms is retired with :data:`STATUS_EXPIRED` rather than
     decoded late. ``priority`` orders dispatch within a bucket (higher
     first; FIFO among equals). Sampling knobs mirror
-    :func:`~marlin_tpu.models.transformer.lm_generate_batch` — requests with
-    different knobs never share a batch (one traced temperature per program
-    invocation). ``seed`` feeds the batch PRNG key: sampled requests
-    (temperature > 0) batch only with same-seed peers, so a different seed's
-    randomness never silently replaces this one's; each slot row then draws
-    its own stream from that key, so exact replay of a sampled output needs
-    the same seed AND the same submission pattern (batch width is fixed, so
-    the row index is what matters). Greedy decode, the default, ignores the
-    key and batches across seeds freely (docs/serving.md)."""
+    :func:`~marlin_tpu.models.transformer.lm_generate_batch`.
+
+    ``seed`` feeds the sampling PRNG. Under the row-level scheduler (the
+    default) each slot row draws its own ``fold_in(key(seed), step)``
+    stream, so a sampled output replays from (seed, prompt) alone —
+    composition-independent, and any knob mix shares a decode step (the
+    knobs are per-row traced). Under the gang fallback the whole batch
+    decodes under one key: requests with different knobs never share a
+    batch, sampled requests batch only with same-seed peers, and exact
+    replay additionally needs the same submission pattern (batch width is
+    fixed, so the row index is what matters). Greedy decode, the default,
+    ignores the key entirely (docs/serving.md).
+
+    ``eos`` names a stop token: under the row-level scheduler a row retires
+    the step it EMITS that token (its slot refills from the queue on the
+    next step), so ``Result.tokens`` may carry fewer than ``steps``
+    generated tokens, ending with the eos. Detection looks only at
+    GENERATED tokens — an eos-valued token inside the prompt or its pad
+    region never stops a row. The gang fallback runs its fused program to
+    completion and ignores ``eos``."""
 
     prompt: Any
     steps: int
@@ -67,6 +78,7 @@ class Request:
     top_p: float | None = None
     top_k: int | None = None
     seed: int = 0
+    eos: int | None = None
     rid: int = dataclasses.field(default_factory=lambda: next(_rid_counter))
 
     def __post_init__(self):
@@ -80,10 +92,13 @@ class Request:
 @dataclasses.dataclass
 class Result:
     """The exactly-once answer to one :class:`Request`. ``tokens`` (status
-    :data:`STATUS_OK` only) is prompt + the requested ``steps`` generated
-    tokens, sliced from the bucket row. ``metrics`` carries the per-request
-    timings (``queue_s``, ``ttft_s``, ``total_s`` — on the engine clock) and
-    the ``bucket`` that executed it."""
+    :data:`STATUS_OK` only) is prompt + the generated tokens — exactly the
+    requested ``steps`` of them, or fewer ending in the stop token when
+    ``Request.eos`` fired under the row-level scheduler. ``metrics``
+    carries the per-request timings on the engine clock (``queue_s``,
+    ``ttft_s`` — time to the first generated token, which row-level prefill
+    makes genuinely earlier than ``total_s``), the ``bucket`` that executed
+    it, and under row-level scheduling the ``slot`` it occupied."""
 
     rid: int
     status: str

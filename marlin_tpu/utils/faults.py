@@ -26,10 +26,17 @@ point               fires from
 ``serve.enqueue``   :meth:`~marlin_tpu.serving.engine.ServeEngine.submit`
                     entry (ctx carries ``path=<rid>``) — a raise here
                     surfaces to the submitting caller
-``serve.step``      the serving worker loop, just before each batch launch
-                    (ctx carries ``path="bucket-<P>x<steps>"``) — a raise
-                    fails that batch's requests with ``error`` Results; the
-                    engine keeps serving
+``serve.step``      the serving worker loop, just before each gang batch
+                    launch / each row-level slot prefill (ctx carries
+                    ``path="bucket-<P>x<steps>"``) — a raise fails that
+                    batch's / that admission's requests with ``error``
+                    Results; the engine keeps serving
+``serve.decode_step``
+                    the row-level scheduler, just before each single-token
+                    decode step over a bucket's KV slab (ctx carries
+                    ``path="bucket-<P>x<steps>"``) — a raise fails only
+                    that step's live rows with ``error`` Results and leaves
+                    the slot pool consistent; queued requests keep serving
 ==================  =========================================================
 
 Behaviors are :class:`Fault` subclasses — :class:`RaiseFault` (raise once /
@@ -63,6 +70,7 @@ __all__ = [
 KNOWN_POINTS = frozenset({
     "ckpt.write", "ckpt.manifest", "fs.open", "fs.list", "step.run",
     "device.probe", "prefetch.produce", "serve.enqueue", "serve.step",
+    "serve.decode_step",
 })
 
 

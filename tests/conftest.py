@@ -7,6 +7,7 @@ becomes NumPy goldens compared via ``to_numpy()`` against a NumPy oracle
 (their pattern: compute distributed, ``toBreeze()``, compare vs Breeze).
 """
 
+import contextlib
 import threading
 import time
 
@@ -14,6 +15,56 @@ import numpy as np
 import pytest
 
 import marlin_tpu as mt
+
+
+# ---------------------------------------------------------------- compiles
+
+class _CompileTally:
+    """Process-wide XLA backend-compile counter fed by a jax.monitoring
+    listener (registered once, lazily — jax.monitoring offers no selective
+    unregister, so a per-test listener would accumulate forever)."""
+
+    count = 0
+    registered = False
+
+    @classmethod
+    def ensure_registered(cls):
+        if cls.registered:
+            return
+        from jax import monitoring
+
+        def _on_duration(event, duration, **kw):
+            if event == "/jax/core/compile/backend_compile_duration":
+                cls.count += 1  # GIL-atomic; fires from any compiling thread
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        cls.registered = True
+
+
+class _CompileCount:
+    def __init__(self):
+        self._start = _CompileTally.count
+
+    @property
+    def count(self) -> int:
+        return _CompileTally.count - self._start
+
+
+@pytest.fixture()
+def compile_count():
+    """Count XLA compiles around a block — the reusable compile-bound guard
+    (serving + prefetch suites): ``with compile_count() as c: ...;
+    assert c.count <= bound``. Counts every backend compile in the process
+    (any thread — serving workers included), so scope the block tightly and
+    warm auxiliary one-time programs (PRNG key creation, dtype converts)
+    before asserting an exact bound."""
+    _CompileTally.ensure_registered()
+
+    @contextlib.contextmanager
+    def guard():
+        yield _CompileCount()
+
+    return guard
 
 
 # worker-thread name prefixes owned by the library; each subsystem joins its

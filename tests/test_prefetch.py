@@ -231,13 +231,22 @@ def test_chaos_through_streamed_gramian():
 
 
 # -------------------------------------------------------------- equivalence
-def test_streamed_matmul_equivalence_on_off():
+def test_streamed_matmul_equivalence_on_off(compile_count):
+    """Prefetch on/off is bit-for-bit equivalent, and streaming traffic is
+    compile-bounded: once both paths have run, further streamed multiplies
+    add ZERO XLA compiles — the same compile-bound guard the serving suite
+    uses (tests/conftest.py compile_count)."""
     rng = np.random.default_rng(7)
     a = rng.standard_normal((640, 24)).astype(np.float32)
     b = rng.standard_normal((24, 8)).astype(np.float32)
-    on = mt.streamed_matmul(a, b, chunk_rows=100, prefetch=True)
     off = mt.streamed_matmul(a, b, chunk_rows=100, prefetch=False)
+    on = mt.streamed_matmul(a, b, chunk_rows=100, prefetch=True)
     np.testing.assert_array_equal(on, off)  # bit-for-bit, not allclose
+    with compile_count() as c:
+        again = mt.streamed_matmul(a, b, chunk_rows=100, prefetch=True)
+    np.testing.assert_array_equal(again, off)
+    assert c.count == 0, \
+        f"a warm streamed multiply recompiled ({c.count} programs)"
 
 
 def test_streamed_gramian_equivalence_on_off():

@@ -36,6 +36,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -615,12 +616,24 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     (d_model=128, heads=8, layers=4) is sized so decode COMPUTE is
     non-trivial relative to dispatch — the serving regime; at toy sizes the
     sweep measures Python/dispatch overhead, where a fused gang program
-    always looks best."""
+    always looks best.
+
+    Observability ride-along (docs/observability.md): a /metrics endpoint
+    (MARLIN_BENCH_OBS_PORT, default ephemeral) is scraped DURING the first
+    rate's live serve, every serve record lands in a JSONL
+    (MARLIN_BENCH_SERVE_EVENTS, default under $TMPDIR) with request trace
+    ids, and a `serve_obs` record reports scrape families + trace join —
+    the proof the layer sees traffic without steering it."""
+    import urllib.request
+
     import jax  # noqa: F401  (backend init before threads)
 
     import marlin_tpu as mt  # noqa: F401
+    from marlin_tpu import obs
     from marlin_tpu.models import TransformerLM
+    from marlin_tpu.obs import collectors
     from marlin_tpu.serving import Request, ServeEngine, percentile
+    from marlin_tpu.utils.tracing import EventLog, set_default_event_log
 
     rates = [float(r) for r in os.environ.get(
         "MARLIN_BENCH_SERVE_RATES", "4,16,64").split(",")]
@@ -636,7 +649,21 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     params = lm.init_params()
     rng = np.random.default_rng(0)
 
-    for rate in rates:
+    events_path = os.environ.get("MARLIN_BENCH_SERVE_EVENTS") or os.path.join(
+        tempfile.gettempdir(),
+        f"marlin_serve_events{'' if rowlevel else '_gang'}.jsonl")
+    for suffix in ("", ".1", ".2"):  # fresh stream per sweep
+        if os.path.exists(events_path + suffix):
+            os.remove(events_path + suffix)
+    elog = EventLog(events_path)
+    prev_log = set_default_event_log(elog)
+    srv = obs.MetricsServer(port=int(os.environ.get("MARLIN_BENCH_OBS_PORT",
+                                                    "0")))
+    obs_port = srv.start()  # installs compile + device-memory collectors
+    scrape = ""
+
+    def run_rate(rate):
+        nonlocal scrape
         eng = ServeEngine(params, heads, buckets=buckets,
                           max_batch=max_batch, max_wait_ms=5.0,
                           queue_depth=4 * n_req, rowlevel=rowlevel)
@@ -654,10 +681,30 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
                 handles.append(eng.submit(Request(
                     prompt=rng.integers(0, vocab, plen).astype(np.int32),
                     steps=int(rng.integers(steps_lo, steps_hi + 1)))))
+            scraper = None
+            if not scrape:
+                # scrape DURING the live serve (requests still in flight at
+                # the first offered rate): the endpoint must show traffic
+                # while it happens, not post-hoc aggregates. Off-thread so a
+                # slow scrape never inflates the measured span — the tok/s
+                # this sweep records is the passivity evidence.
+                def _scrape_live():
+                    nonlocal scrape
+                    collectors.log_device_memory(elog)  # mem timeline
+                    try:
+                        scrape = urllib.request.urlopen(
+                            f"http://127.0.0.1:{obs_port}/metrics",
+                            timeout=10).read().decode()
+                    except Exception:
+                        pass  # next rate retries; the record shows 0/7
+                scraper = threading.Thread(target=_scrape_live, daemon=True)
+                scraper.start()
             eng.drain()
             span = time.perf_counter() - t_start
         finally:
             eng.close()
+        if scraper is not None:
+            scraper.join(timeout=15.0)
         results = [h.result(timeout=0) for h in handles]
         ok = [r for r in results if r.ok]
         lat = [r.metrics["total_s"] for r in ok]
@@ -681,6 +728,33 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
                f"{ms(ttft, 50)} ms / p99 {ms(ttft, 99)} ms; occupancy "
                f"{snap['occupancy_mean']}, {sched}, "
                f"warmup={'on' if warmup else 'off'}")
+
+    try:
+        for rate in rates:
+            run_rate(rate)
+    finally:
+        # a mid-sweep failure must not leak the default log / endpoint into
+        # the rest of the bench process (main() catches and keeps sweeping)
+        srv.close()
+        set_default_event_log(prev_log)
+        elog.close()
+
+    # ---- observability acceptance record: scrape families + trace join
+    want = ("marlin_serve_submitted_total", "marlin_serve_queue_depth",
+            "marlin_serve_slot_occupancy", "marlin_serve_kv_inflight_bytes",
+            "marlin_compile_total", "marlin_prefetch_chunks_total",
+            "marlin_device_memory_bytes_in_use")
+    got = [n for n in want if f"# TYPE {n} " in scrape]
+    # same "trace-joined" definition as python -m marlin_tpu.obs.report
+    from marlin_tpu.obs.report import trace_join
+    joined, total = trace_join(elog.read(include_rotated=True))
+    trace_note = (f"{joined}/{total} requests trace-joined"
+                  if total else "no serve events recorded")
+    record("serve_obs" + ("" if rowlevel else "_gang"), float(len(got)),
+           "families",
+           f"live /metrics scrape during serve carried {len(got)}/{len(want)}"
+           f" series ({', '.join(got)}); {trace_note}; events at "
+           f"{events_path} (analyze: python -m marlin_tpu.obs.report)")
 
 
 def config_svd(m=1_000_000, n=512, k=8):

@@ -60,6 +60,7 @@ from .io import (  # noqa: F401
 )
 from .utils import evaluate, timer  # noqa: F401
 from .lazy import fuse  # noqa: F401
+from . import obs  # noqa: F401
 from . import random  # noqa: F401
 
 __version__ = "0.3.0"

@@ -115,6 +115,18 @@ class MarlinConfig:
     # None = ~/.cache/marlin_tpu/autotune.json; "" disables the disk layer
     # (in-process caching still works).
     autotune_cache_path: str | None = None
+    # --- observability (obs/) ------------------------------------------------
+    # Port for the Prometheus /metrics endpoint started by
+    # obs.start_from_config(): None disables (the default), 0 binds an
+    # ephemeral port (read it off the returned server), otherwise the fixed
+    # port. Loopback-bound; exposition is read-only.
+    obs_http_port: int | None = None
+    # Size-based EventLog rotation: a write that would push the log file past
+    # this many bytes rotates it first (path -> path.1 -> path.2; two
+    # backups kept, the oldest dropped). 0 = unbounded — fine for bounded
+    # runs, not for a long-running serve loop flushing per event. Per-log
+    # override: EventLog(..., max_bytes=...).
+    obs_log_max_bytes: int = 0
 
 
 _config = MarlinConfig()

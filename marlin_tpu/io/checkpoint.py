@@ -39,6 +39,7 @@ save-matrices-to-HDFS regime (utils/MTUtils.scala:350-392). Local paths keep
 
 from __future__ import annotations
 
+import contextlib
 import io as _io
 import json
 import os
@@ -50,7 +51,9 @@ import jax
 import numpy as np
 
 from ..config import get_config
+from ..obs import trace as _trace
 from ..utils import faults as _faults
+from ..utils.tracing import get_default_event_log
 from .fs import (ensure_dir, join_path, list_names, local_path, open_path,
                  remove_path)
 
@@ -64,6 +67,22 @@ _COMMITTED = "COMMITTED"
 
 _GEN_DIR_RE = re.compile(r"ckpt_(\d+)")
 _GEN_NPZ_RE = re.compile(r"ckpt_(\d+)\.npz")
+
+
+@contextlib.contextmanager
+def _span_event(name: str, **fields):
+    """One span + one timed EventLog record around a checkpoint operation:
+    the record (kind ``"ckpt"``, ``seconds``, ``ok`` — it lands even when
+    the body raises) carries the span's ids, and so does everything the
+    body causes (retrying remote IO, fault records), joining the whole
+    save/restore into one trace in the JSONL."""
+    with _trace.span(name):
+        log = get_default_event_log()
+        if log is None:
+            yield
+        else:
+            with log.timed("ckpt", **fields):
+                yield
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -427,6 +446,11 @@ def save_checkpoint(state, path: str, step: int, keep: int | None = None) -> Non
     ``keep`` bounds retention to the newest ``keep`` committed generations
     (None defers to the ``ckpt_keep`` config; 0 keeps everything).
     """
+    with _span_event("ckpt.save", ev="save", step=step):
+        _save_checkpoint(state, path, step, keep)
+
+
+def _save_checkpoint(state, path: str, step: int, keep: int | None) -> None:
     ensure_dir(path)
     final = join_path(path, _gen_name(step))
     _faults.fire("ckpt.write", path=final, step=step)
@@ -532,6 +556,11 @@ def load_checkpoint(state_like, path: str, step: int | None = None,
     different model configuration — error, never silently swap architectures),
     and each leaf is re-placed onto the template leaf's sharding so
     tensor/data-parallel placements survive the restore."""
+    with _span_event("ckpt.load", ev="load", step=step):
+        return _load_checkpoint(state_like, path, step, verify)
+
+
+def _load_checkpoint(state_like, path: str, step: int | None, verify: bool):
     if step is None:
         gens = list_generations(path)
         if gens:

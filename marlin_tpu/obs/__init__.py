@@ -1,0 +1,39 @@
+"""Unified observability: metrics registry, exposition, traces, analysis.
+
+The reference's observability was ``System.currentTimeMillis`` deltas and
+printlns (SURVEY §5.1/§5.5); this package is the layer that exceeds it,
+unifying what used to be four disconnected fragments (EventLog JSONL,
+ServeMetrics counters, StageTimes, a test-only compile tally):
+
+- :mod:`~marlin_tpu.obs.metrics` — thread-safe process-global registry of
+  labeled ``Counter``/``Gauge``/``Histogram`` families with Prometheus
+  text exposition (every existing counter in the library records here).
+- :mod:`~marlin_tpu.obs.exposition` — stdlib ``http.server`` ``/metrics``
+  endpoint; :func:`start_from_config` starts it from ``obs_http_port``.
+- :mod:`~marlin_tpu.obs.collectors` — the jax.monitoring compile bridge,
+  device-memory gauges next to the planner's HBM budget.
+- :mod:`~marlin_tpu.obs.trace` — contextvars span propagation so every
+  EventLog record carries ``trace_id``/``span_id``/``parent_id`` and one
+  serving request (or checkpoint save, or streamed op) is one joinable
+  trace in the JSONL.
+- :mod:`~marlin_tpu.obs.report` — the post-hoc analyzer
+  (``python -m marlin_tpu.obs.report events.jsonl``).
+
+docs/observability.md walks the whole surface.
+"""
+
+from . import trace  # noqa: F401  (stdlib-only; must import first — see below)
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    percentile,
+)
+from .exposition import MetricsServer, start_from_config  # noqa: F401
+from . import collectors  # noqa: F401  (imports utils.tracing lazily)
+
+__all__ = ["trace", "collectors", "Counter", "Gauge", "Histogram",
+           "MetricsRegistry", "get_registry", "percentile", "MetricsServer",
+           "start_from_config"]

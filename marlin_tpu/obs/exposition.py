@@ -1,0 +1,135 @@
+"""Prometheus ``/metrics`` HTTP exposition over the stdlib ``http.server``.
+
+:class:`MetricsServer` binds a loopback (by default) port and serves the
+process registry's text rendering at ``/metrics`` (plus ``/healthz`` for
+liveness probes) from one daemon thread named ``marlin-obs-http-*`` — the
+test suite's thread-leak fixture watches the prefix, and :meth:`close`
+joins it. :func:`start_from_config` is the config-driven entry: it starts
+a server when ``config.obs_http_port`` is set (0 = ephemeral port) and
+returns None when observability exposition is disabled (the default), so
+long-running entrypoints can call it unconditionally.
+
+Starting a server also installs the default runtime collectors
+(:func:`marlin_tpu.obs.collectors.install_default_collectors`): a scrapeable
+endpoint with no compile or device-memory series would silently re-open the
+exact blind spots this layer exists to close.
+"""
+
+from __future__ import annotations
+
+import http.server
+import itertools
+import threading
+
+from .metrics import MetricsRegistry, get_registry
+
+__all__ = ["MetricsServer", "start_from_config"]
+
+_ids = itertools.count()
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    # the registry rides on the server object (one handler class serves
+    # every MetricsServer instance)
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        if self.path.split("?")[0] == "/metrics":
+            body = self.server._marlin_registry.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/healthz":
+            body = b"ok\n"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+        else:
+            body = b"not found\n"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrapes must not spam stderr
+        pass
+
+
+class MetricsServer:
+    """Serve ``registry.render()`` at ``http://host:port/metrics``.
+
+    ``port=0`` binds an ephemeral port; the bound port is :meth:`start`'s
+    return value (and ``.port`` afterwards). Usable as a context manager.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: MetricsRegistry | None = None):
+        self._host = host
+        self._want_port = int(port)
+        self._registry = registry if registry is not None else get_registry()
+        self._httpd: http.server.ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.port: int | None = None
+
+    @property
+    def url(self) -> str:
+        if self.port is None:
+            raise RuntimeError("metrics server not started")
+        return f"http://{self._host}:{self.port}/metrics"
+
+    def start(self) -> int:
+        """Bind and serve from a daemon thread; returns the bound port.
+        Idempotent (a second start returns the live port)."""
+        if self._httpd is not None:
+            return self.port
+        from .collectors import install_default_collectors
+
+        install_default_collectors(self._registry)
+        httpd = http.server.ThreadingHTTPServer(
+            (self._host, self._want_port), _Handler)
+        httpd.daemon_threads = True  # per-request threads must not pin exit
+        httpd._marlin_registry = self._registry
+        self._httpd = httpd
+        self.port = httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, daemon=True,
+            name=f"marlin-obs-http-{next(_ids)}")
+        self._thread.start()
+        return self.port
+
+    def close(self) -> None:
+        """Stop serving and join the server thread. Idempotent; never
+        raises (exposition shutdown rides error paths)."""
+        httpd, thread = self._httpd, self._thread
+        self._httpd = self._thread = None
+        if httpd is None:
+            return
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except Exception:
+            pass
+        if thread is not None:
+            thread.join(timeout=10.0)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def start_from_config(registry: MetricsRegistry | None = None,
+                      ) -> MetricsServer | None:
+    """Start a metrics endpoint when ``config.obs_http_port`` says so
+    (None = disabled, the default; 0 = ephemeral port; otherwise the fixed
+    port). Returns the running server, or None when disabled — callers in
+    long-running entrypoints (benches, serving mains) invoke this
+    unconditionally and close whatever comes back."""
+    from ..config import get_config
+
+    port = get_config().obs_http_port
+    if port is None:
+        return None
+    server = MetricsServer(port=port, registry=registry)
+    server.start()
+    return server

@@ -1,0 +1,235 @@
+"""Post-hoc EventLog analyzer: ``python -m marlin_tpu.obs.report <events.jsonl>``.
+
+Reconstructs what a run did from its JSONL post-mortem stream alone — no
+profiler UI, no live process:
+
+- **per-kind latency** — every record kind carrying ``seconds`` (serving
+  steps, prefills, checkpoint saves, compiles, timers …) gets count and
+  p50/p95/p99/max.
+- **traces** — records join on ``trace_id`` (the span context EventLog
+  stamps, :mod:`marlin_tpu.obs.trace`); the report shows how many records
+  joined and the slowest traces end-to-end.
+- **serving TTFT breakdown** — per-request ``queue_s``/``ttft_s``/``total_s``
+  from ``serve``/``result`` records decomposed into queue vs prefill vs
+  decode time, the serving latency question ("where did the ms go?") in
+  three lines.
+- **compile / memory timelines** — ``kind="compile"`` records (the
+  jax.monitoring bridge) and ``kind="memory"`` samples
+  (:func:`~marlin_tpu.obs.collectors.log_device_memory`) as time-offset
+  listings, so a recompile storm or an HBM creep is visible at a glance.
+
+Reading is torn-line tolerant (the same skip-and-flag contract as
+``EventLog.read``): a crash mid-write costs one partial line, never the
+analysis. Output is deterministic for a given file (fixed formats, sorted
+orders) — the test suite goldens it.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from .metrics import percentile
+
+__all__ = ["load_events", "trace_join", "analyze", "main"]
+
+
+def load_events(path: str) -> tuple[list[dict], int]:
+    """(records, skipped torn/partial lines) from one JSONL file — the one
+    torn-line-tolerant parse (``EventLog.read`` delegates here)."""
+    records, skipped = [], 0
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                skipped += 1
+    return records, skipped
+
+
+def trace_join(records) -> tuple[int, int]:
+    """(requests whose serve records all share one non-None ``trace_id``,
+    total rid-carrying requests). One definition of "trace-joined" shared by
+    this analyzer and the bench's ``serve_obs`` acceptance record."""
+    rid_traces: dict = {}
+    for r in records:
+        if r.get("kind") == "serve" and "rid" in r:
+            rid_traces.setdefault(r["rid"], set()).add(r.get("trace_id"))
+    joined = sum(1 for tids in rid_traces.values()
+                 if len(tids) == 1 and None not in tids)
+    return joined, len(rid_traces)
+
+
+def _ms(v: float) -> str:
+    return f"{v * 1e3:.1f}"
+
+
+def _kind_key(rec: dict) -> str:
+    ev = rec.get("ev")
+    return f"{rec['kind']}/{ev}" if ev else rec["kind"]
+
+
+def _latency_section(events: list[dict]) -> list[str]:
+    by_kind: dict[str, list[float]] = {}
+    for rec in events:
+        if isinstance(rec.get("seconds"), (int, float)):
+            by_kind.setdefault(_kind_key(rec), []).append(rec["seconds"])
+    out = ["== per-kind latency (records carrying `seconds`) =="]
+    if not by_kind:
+        out.append("(none)")
+        return out
+    out.append(f"{'kind':<18}{'count':>6}{'p50 ms':>10}{'p95 ms':>10}"
+               f"{'p99 ms':>10}{'max ms':>10}{'total s':>10}")
+    for kind in sorted(by_kind):
+        xs = by_kind[kind]
+        out.append(
+            f"{kind:<18}{len(xs):>6}{_ms(percentile(xs, 50)):>10}"
+            f"{_ms(percentile(xs, 95)):>10}{_ms(percentile(xs, 99)):>10}"
+            f"{_ms(max(xs)):>10}{sum(xs):>10.3f}")
+    return out
+
+
+def _trace_section(events: list[dict]) -> list[str]:
+    traces: dict[str, list[dict]] = {}
+    for rec in events:
+        tid = rec.get("trace_id")
+        if tid:
+            traces.setdefault(tid, []).append(rec)
+    in_traces = sum(len(v) for v in traces.values())
+    out = ["== traces =="]
+    if not traces:
+        out.append("(no trace_id-carrying records)")
+        return out
+    spans = {rec.get("span_id") for recs in traces.values() for rec in recs}
+    out.append(f"traces: {len(traces)}   spans: {len(spans)}   "
+               f"records in traces: {in_traces}/{len(events)}")
+    ranked = sorted(
+        traces.items(),
+        key=lambda kv: (-(max(r.get("t", 0.0) for r in kv[1])
+                          - min(r.get("t", 0.0) for r in kv[1])), kv[0]))
+    out.append("slowest traces:")
+    for tid, recs in ranked[:5]:
+        dur = (max(r.get("t", 0.0) for r in recs)
+               - min(r.get("t", 0.0) for r in recs))
+        kinds = ",".join(sorted({_kind_key(r) for r in recs}))
+        out.append(f"  {tid}  records={len(recs)}  span={dur:.3f}s  "
+                   f"kinds={kinds}")
+    return out
+
+
+def _serving_section(events: list[dict]) -> list[str]:
+    serve = [r for r in events if r.get("kind") == "serve"]
+    out = ["== serving =="]
+    if not serve:
+        out.append("(no serve records)")
+        return out
+    results = [r for r in serve if r.get("ev") == "result"]
+    by_status: dict[str, int] = {}
+    for r in results:
+        by_status[r.get("status", "?")] = by_status.get(
+            r.get("status", "?"), 0) + 1
+    submitted = sum(1 for r in serve if r.get("ev") == "enqueue")
+    status_str = ", ".join(f"{k} {v}" for k, v in sorted(by_status.items()))
+    out.append(f"requests: submitted {submitted}; results: {status_str}")
+    ok = [r for r in results if r.get("status") == "ok"
+          and isinstance(r.get("total_s"), (int, float))]
+    if ok:
+        queue = [r.get("queue_s", 0.0) or 0.0 for r in ok]
+        ttft = [r.get("ttft_s") if r.get("ttft_s") is not None
+                else r["total_s"] for r in ok]
+        prefill = [max(t - q, 0.0) for t, q in zip(ttft, queue)]
+        decode = [max(r["total_s"] - t, 0.0) for r, t in zip(ok, ttft)]
+        total = [r["total_s"] for r in ok]
+        out.append("TTFT breakdown over ok results (p50 / p99 ms):")
+        for name, xs in (("queue", queue), ("prefill", prefill),
+                         ("decode", decode), ("total", total)):
+            out.append(f"  {name:<8}{_ms(percentile(xs, 50)):>9} / "
+                       f"{_ms(percentile(xs, 99))}")
+    # the trace-join check: every record a request produced under ONE id
+    joined, total = trace_join(serve)
+    if total:
+        out.append(f"trace join: {joined}/{total} requests have "
+                   f"all their records under one trace_id")
+    return out
+
+
+def _timeline_section(events: list[dict], t0: float) -> list[str]:
+    out = []
+    compiles = [r for r in events if r.get("kind") == "compile"
+                and isinstance(r.get("seconds"), (int, float))]
+    out.append("== compile ==")
+    if compiles:
+        out.append(f"compiles: {len(compiles)}, total "
+                   f"{sum(r['seconds'] for r in compiles):.3f}s")
+        for r in compiles[:20]:
+            out.append(f"  t+{r['t'] - t0:.3f}s  {r['seconds']:.3f}s")
+        if len(compiles) > 20:
+            out.append(f"  ... {len(compiles) - 20} more")
+    else:
+        out.append("(no compile records — jax.monitoring bridge not "
+                   "installed?)")
+    mem = [r for r in events if r.get("kind") == "memory"
+           and isinstance(r.get("devices"), dict)]
+    out.append("")
+    out.append("== memory ==")
+    if mem:
+        peak, peak_dev = 0, "?"
+        for r in mem:
+            for dev, b in r["devices"].items():
+                if b >= peak:
+                    peak, peak_dev = b, dev
+        out.append(f"samples: {len(mem)}, peak bytes_in_use: {peak} "
+                   f"({peak_dev})")
+        for r in mem[:20]:
+            devs = " ".join(f"{d}={b}" for d, b in sorted(
+                r["devices"].items()))
+            out.append(f"  t+{r['t'] - t0:.3f}s  {devs}")
+        if len(mem) > 20:
+            out.append(f"  ... {len(mem) - 20} more")
+    else:
+        out.append("(no memory samples — collectors.log_device_memory "
+                   "never ran, or the backend exposes no memory_stats)")
+    return out
+
+
+def analyze(events: list[dict], skipped: int = 0) -> str:
+    """The full deterministic report for one event stream."""
+    out = ["== marlin_tpu.obs.report =="]
+    if not events:
+        out.append("events: 0")
+        return "\n".join(out) + "\n"
+    events = sorted(events, key=lambda r: r.get("t", 0.0))
+    t0 = events[0].get("t", 0.0)
+    span = events[-1].get("t", 0.0) - t0
+    torn = f"  ({skipped} torn line(s) skipped)" if skipped else ""
+    out.append(f"events: {len(events)}  span: {span:.3f}s{torn}")
+    out.append("")
+    out.extend(_latency_section(events))
+    out.append("")
+    out.extend(_trace_section(events))
+    out.append("")
+    out.extend(_serving_section(events))
+    out.append("")
+    out.extend(_timeline_section(events, t0))
+    return "\n".join(out) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print("usage: python -m marlin_tpu.obs.report <events.jsonl>",
+              file=sys.stderr)
+        return 2
+    try:
+        events, skipped = load_events(argv[0])
+    except OSError as e:
+        print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
+        return 1
+    sys.stdout.write(analyze(events, skipped))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

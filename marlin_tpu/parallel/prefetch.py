@@ -47,12 +47,41 @@ from typing import Any, Callable, Iterable
 import jax
 
 from ..config import get_config
+from ..obs import trace as obs_trace
+from ..obs.metrics import get_registry
 from ..utils import faults
 from ..utils.profiling import StageTimes
 
 __all__ = ["ChunkPrefetcher", "prefetch_chunks"]
 
 _ids = itertools.count()
+
+_families = None  # lazy singleton: one set of registry families, all pipelines
+
+
+def _metric_families():
+    """(chunks counter, stall-seconds counter, ready-depth gauge,
+    in-flight-bytes gauge) — shared by every pipeline in the process (the
+    Prometheus model: the scrape sees the aggregate, per-op splits live in
+    the per-op StageTimes). obs.collectors touches this at endpoint start
+    so the series exist even before the first streamed op."""
+    global _families
+    if _families is None:
+        reg = get_registry()
+        _families = (
+            reg.counter("marlin_prefetch_chunks_total",
+                        "Chunks delivered to consumers by prefetch "
+                        "pipelines"),
+            reg.counter("marlin_prefetch_stall_seconds_total",
+                        "Seconds consumers waited on the prefetch queue "
+                        "(un-overlapped producer latency)"),
+            reg.gauge("marlin_prefetch_ready_depth",
+                      "Produced-but-unconsumed chunks buffered right now"),
+            reg.gauge("marlin_prefetch_inflight_bytes",
+                      "Bytes of prefetched-but-unconsumed chunks counted "
+                      "against the HBM budget"),
+        )
+    return _families
 
 
 class ChunkPrefetcher:
@@ -87,6 +116,11 @@ class ChunkPrefetcher:
         self._transform = transform
         self._device_put = device_put
         self.stats = stats if stats is not None else StageTimes()
+        self._metrics = _metric_families()
+        # producer threads inherit the *creating* thread's span context, so
+        # chunk-pipeline records (fault retries, the close summary) join the
+        # streamed op's / checkpoint's trace (obs/trace.py thread handoff)
+        self._span = obs_trace.capture()
 
         self._src_lock = threading.Lock()  # serializes next(it) + index assignment
         self._cv = threading.Condition()
@@ -112,6 +146,10 @@ class ChunkPrefetcher:
 
     # ---------------------------------------------------------------- producer
     def _work(self) -> None:
+        with obs_trace.use(self._span):
+            self._work_loop()
+
+    def _work_loop(self) -> None:
         while not self._stop.is_set():
             # bounded queue: one slot per chunk in flight; timed acquire so a
             # close() while blocked here is noticed (close also over-releases)
@@ -156,6 +194,7 @@ class ChunkPrefetcher:
                     # admission cursor past i — successors must not stall
                     # against a chunk that will never be admitted
                     self._inflight_bytes -= admitted
+                    self._metrics[3].dec(admitted)  # refund the gauge too
                     if self._next_admit == i:
                         self._next_admit = i + 1
                     self._cv.notify_all()
@@ -173,6 +212,8 @@ class ChunkPrefetcher:
         fits (``inflight == 0``), so an undersized budget serializes instead
         of deadlocking. Returns False if closed while waiting."""
         with self._cv:
+            if self._stop.is_set():
+                return False  # closed: don't touch the (shared) gauges
             if self._budget > 0:
                 while not self._stop.is_set() and (
                         self._next_admit != i
@@ -183,6 +224,9 @@ class ChunkPrefetcher:
                     return False
                 self._next_admit = i + 1
             self._inflight_bytes += nbytes
+            # gauges move by deltas: several pipelines may run concurrently
+            # and the scrape must see their sum, not the last writer
+            self._metrics[3].inc(nbytes)
             self._cv.notify_all()
             return True
 
@@ -190,6 +234,7 @@ class ChunkPrefetcher:
         with self._cv:
             if not self._stop.is_set():
                 self._ready[i] = item
+                self._metrics[2].inc()
             self._cv.notify_all()
 
     def _finish(self, end: int) -> None:
@@ -215,7 +260,11 @@ class ChunkPrefetcher:
                 # forever without close() being able to intervene
                 self._cv.wait(0.1)
             item = self._ready.pop(j, None)
-        self.stats.add("stall", time.perf_counter() - t0)
+            if item is not None:
+                self._metrics[2].dec()
+        stall = time.perf_counter() - t0
+        self.stats.add("stall", stall)
+        self._metrics[1].inc(stall)
         if item is None:  # clean exhaustion
             self.close()
             raise StopIteration
@@ -226,8 +275,10 @@ class ChunkPrefetcher:
             raise payload
         with self._cv:
             self._inflight_bytes -= nbytes
+            self._metrics[3].dec(nbytes)
             self._cv.notify_all()
         self._slots.release()
+        self._metrics[0].inc()
         return payload
 
     # ------------------------------------------------------------- lifecycle
@@ -246,6 +297,10 @@ class ChunkPrefetcher:
                 return
             self._closed = True
             self._stop.set()
+            # release only THIS pipeline's contribution to the shared
+            # gauges — a concurrent pipeline's buffered chunks stay counted
+            self._metrics[2].dec(len(self._ready))
+            self._metrics[3].dec(self._inflight_bytes)
             self._ready.clear()
             self._inflight_bytes = 0
             self._cv.notify_all()

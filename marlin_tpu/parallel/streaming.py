@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import get_config
+from ..obs import trace as obs_trace
 from ..utils.profiling import StageTimes
 from .prefetch import ChunkPrefetcher
 
@@ -159,19 +160,23 @@ def streamed_matmul(
                 results.append(y_np)
             offset += y_np.shape[0]
 
-    stream, closer = _chunk_stream(a_source, chunk_rows, transfer_dtype,
-                                   prefetch, stats)
-    try:
-        for x in stream:
-            saw_chunk = True
-            with stats.timed("compute"):
-                pending.append(chunk_mm(x))
-            drain(1)  # keep one result in flight: overlap compute and D2H
-        if not saw_chunk:
-            raise ValueError("empty input stream")
-        drain(0)
-    finally:
-        closer()
+    # one span per streamed op: the prefetcher's producer threads inherit it
+    # (it is created inside), so the op's chunk records + close summary join
+    # into one trace in the JSONL (docs/observability.md)
+    with obs_trace.span("streamed_matmul"):
+        stream, closer = _chunk_stream(a_source, chunk_rows, transfer_dtype,
+                                       prefetch, stats)
+        try:
+            for x in stream:
+                saw_chunk = True
+                with stats.timed("compute"):
+                    pending.append(chunk_mm(x))
+                drain(1)  # keep one result in flight: overlap compute + D2H
+            if not saw_chunk:
+                raise ValueError("empty input stream")
+            drain(0)
+        finally:
+            closer()
     return out if out is not None else np.concatenate(results, axis=0)
 
 
@@ -219,20 +224,22 @@ def streamed_gramian(
     # with no explicit transfer dtype, upload in the accumulation dtype (the
     # pre-existing contract: `dtype` governs both upload width and accumulator)
     effective_transfer = transfer_dtype if transfer_dtype is not None else dtype
-    stream, closer = _chunk_stream(a_source, chunk_rows, effective_transfer,
-                                   prefetch, stats)
-    try:
-        for x in stream:
-            if n_cols is not None and x.shape[1] != n_cols:
-                raise ValueError(f"chunk has {x.shape[1]} cols, expected {n_cols}")
-            if g is None:
-                n_cols = x.shape[1]
-                g = jnp.zeros((n_cols, n_cols), dtype)
-            with stats.timed("compute"):
-                g = accumulate(g, x)
-    finally:
-        closer()
-    if g is None:
-        raise ValueError("empty input stream")
-    with stats.timed("drain"):
-        return np.asarray(jax.device_get(g))
+    with obs_trace.span("streamed_gramian"):  # as in streamed_matmul
+        stream, closer = _chunk_stream(a_source, chunk_rows,
+                                       effective_transfer, prefetch, stats)
+        try:
+            for x in stream:
+                if n_cols is not None and x.shape[1] != n_cols:
+                    raise ValueError(
+                        f"chunk has {x.shape[1]} cols, expected {n_cols}")
+                if g is None:
+                    n_cols = x.shape[1]
+                    g = jnp.zeros((n_cols, n_cols), dtype)
+                with stats.timed("compute"):
+                    g = accumulate(g, x)
+        finally:
+            closer()
+        if g is None:
+            raise ValueError("empty input stream")
+        with stats.timed("drain"):
+            return np.asarray(jax.device_get(g))

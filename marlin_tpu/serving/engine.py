@@ -59,6 +59,7 @@ import time
 import numpy as np
 
 from ..config import get_config
+from ..obs import trace as obs_trace
 from ..utils import faults
 from .batcher import (BatchFormer, bucket_kv_bytes, normalize_buckets,
                       pick_bucket, warmup_buckets)
@@ -81,17 +82,23 @@ _POLL_CAP_S = 0.02
 class _Entry:
     """One admitted request riding through the former to a batch slot.
     ``queue_s`` is stamped when the row-level scheduler claims the entry
-    for a slot (the gang path derives it at dispatch instead)."""
+    for a slot (the gang path derives it at dispatch instead). ``trace``
+    is the request's span context (obs/trace.py), captured at submit and
+    re-activated by the worker thread around every record the request
+    produces — that cross-thread handoff is what joins one request's
+    enqueue/prefill/result records into one trace in the JSONL."""
 
-    __slots__ = ("request", "handle", "bucket", "cost", "enq_t", "queue_s")
+    __slots__ = ("request", "handle", "bucket", "cost", "enq_t", "queue_s",
+                 "trace")
 
-    def __init__(self, request, handle, bucket, cost, enq_t):
+    def __init__(self, request, handle, bucket, cost, enq_t, trace=None):
         self.request = request
         self.handle = handle
         self.bucket = bucket
         self.cost = cost
         self.enq_t = enq_t
         self.queue_s = None
+        self.trace = trace
 
 
 class ServeEngine:
@@ -226,7 +233,17 @@ class ServeEngine:
     def submit(self, request: Request) -> ResultHandle:
         """Admit one request. Always returns a handle that will carry exactly
         one Result; overload / no-bucket / past-deadline submissions resolve
-        immediately with ``rejected`` / ``expired`` status and a reason."""
+        immediately with ``rejected`` / ``expired`` status and a reason.
+
+        Opens the request's span (a child of the caller's active span when
+        there is one, else a fresh trace root), so every record the request
+        ever produces — here and on the worker thread — shares one
+        ``trace_id``."""
+        ctx = obs_trace.child_of_current(f"serve.request.{request.rid}")
+        with obs_trace.use(ctx):
+            return self._submit(request, ctx)
+
+    def _submit(self, request: Request, ctx) -> ResultHandle:
         faults.fire("serve.enqueue", path=str(request.rid))
         handle = ResultHandle(request)
         now = self._clock()
@@ -245,7 +262,7 @@ class ServeEngine:
         reason = self._queue.try_admit(cost)
         if reason is not None:
             return self._refuse(handle, STATUS_REJECTED, reason)
-        entry = _Entry(request, handle, bucket, cost, now)
+        entry = _Entry(request, handle, bucket, cost, now, trace=ctx)
         with self._cond:
             if self._state != "running":
                 admitted = False
@@ -258,6 +275,8 @@ class ServeEngine:
             return self._refuse(handle, STATUS_REJECTED,
                                 "engine is shutting down")
         self.metrics.record_enqueue(request.rid, bucket, self._queue.count)
+        self.metrics.record_queue(self._queue.count,
+                                  self._queue.bytes_in_flight)
         return handle
 
     def submit_many(self, requests) -> list[ResultHandle]:
@@ -326,11 +345,17 @@ class ServeEngine:
     def _retire(self, entry: _Entry, result: Result) -> None:
         entry.handle._set(result)
         self._queue.release(entry.cost)
-        self.metrics.record_result(
-            result.rid, result.status, bucket=result.metrics.get("bucket"),
-            queue_s=result.metrics.get("queue_s"),
-            total_s=result.metrics.get("total_s"),
-            ttft_s=result.metrics.get("ttft_s"))
+        # re-activate the request's span on whichever thread retires it, so
+        # the result record joins the request's trace
+        with obs_trace.use(entry.trace):
+            self.metrics.record_result(
+                result.rid, result.status,
+                bucket=result.metrics.get("bucket"),
+                queue_s=result.metrics.get("queue_s"),
+                total_s=result.metrics.get("total_s"),
+                ttft_s=result.metrics.get("ttft_s"))
+        self.metrics.record_queue(self._queue.count,
+                                  self._queue.bytes_in_flight)
 
     # ------------------------------------------------- row-level scheduler
 
@@ -403,47 +428,53 @@ class ServeEngine:
         from ..models.transformer import lm_prefill_slot
 
         for e in claimed:
-            now = self._clock()
-            r = e.request
-            dl = r.deadline
-            p, s = e.bucket
-            if dl is not None and dl <= now:
-                self._retire(e, Result(
-                    r.rid, STATUS_EXPIRED,
-                    reason=f"deadline {dl} passed before dispatch "
-                           f"(dispatched at {now})",
-                    metrics={"bucket": e.bucket, "queue_s": now - e.enq_t,
-                             "total_s": now - e.enq_t}))
-                continue
-            e.queue_s = now - e.enq_t
-            try:
-                faults.fire("serve.step", path=f"bucket-{p}x{s}")
-                pool = pools.get(e.bucket)
-                if pool is None:
-                    pool = pools[e.bucket] = SlotPool(
-                        self.params, self.heads, e.bucket, self.max_batch,
-                        self.compute_dtype)
-                slot = pool.free_slots()[0]
-                prompt = np.zeros((p,), np.int32)
-                n = r.prompt.shape[0]
-                prompt[:n] = r.prompt
-                t0 = time.perf_counter()
-                caches, tokens, first = lm_prefill_slot(
-                    self.params, pool.caches, pool.tokens, slot, prompt, n,
-                    heads=self.heads, max_len=p + s, seed=r.seed,
-                    temperature=r.temperature, top_p=r.top_p, top_k=r.top_k,
-                    compute_dtype=self.compute_dtype, moe=self.moe)
-                first = int(first)  # device sync: the first token exists
-                wall = time.perf_counter() - t0
-            except Exception as exc:
-                self._admit_failure(pools, e, exc)
-                continue
-            pool.caches, pool.tokens = caches, tokens
-            pool.assign(slot, e)
-            pool.ttft_s[slot] = self._clock() - e.enq_t
-            self.metrics.record_prefill(e.bucket, wall)
-            if r.steps == 1 or (r.eos is not None and first == r.eos):
-                self._retire_row(pool, slot, STATUS_OK, self._clock())
+            # the worker runs every request's admission inside that
+            # request's span: its prefill record — and any compile the
+            # bridge observes during it — joins the request's trace
+            with obs_trace.use(e.trace):
+                now = self._clock()
+                r = e.request
+                dl = r.deadline
+                p, s = e.bucket
+                if dl is not None and dl <= now:
+                    self._retire(e, Result(
+                        r.rid, STATUS_EXPIRED,
+                        reason=f"deadline {dl} passed before dispatch "
+                               f"(dispatched at {now})",
+                        metrics={"bucket": e.bucket,
+                                 "queue_s": now - e.enq_t,
+                                 "total_s": now - e.enq_t}))
+                    continue
+                e.queue_s = now - e.enq_t
+                try:
+                    faults.fire("serve.step", path=f"bucket-{p}x{s}")
+                    pool = pools.get(e.bucket)
+                    if pool is None:
+                        pool = pools[e.bucket] = SlotPool(
+                            self.params, self.heads, e.bucket,
+                            self.max_batch, self.compute_dtype)
+                    slot = pool.free_slots()[0]
+                    prompt = np.zeros((p,), np.int32)
+                    n = r.prompt.shape[0]
+                    prompt[:n] = r.prompt
+                    t0 = time.perf_counter()
+                    caches, tokens, first = lm_prefill_slot(
+                        self.params, pool.caches, pool.tokens, slot, prompt,
+                        n, heads=self.heads, max_len=p + s, seed=r.seed,
+                        temperature=r.temperature, top_p=r.top_p,
+                        top_k=r.top_k, compute_dtype=self.compute_dtype,
+                        moe=self.moe)
+                    first = int(first)  # device sync: the first token exists
+                    wall = time.perf_counter() - t0
+                except Exception as exc:
+                    self._admit_failure(pools, e, exc)
+                    continue
+                pool.caches, pool.tokens = caches, tokens
+                pool.assign(slot, e)
+                pool.ttft_s[slot] = self._clock() - e.enq_t
+                self.metrics.record_prefill(e.bucket, wall, rid=r.rid)
+                if r.steps == 1 or (r.eos is not None and first == r.eos):
+                    self._retire_row(pool, slot, STATUS_OK, self._clock())
 
     def _step_rowlevel(self, pools) -> None:
         """Retire expired live rows, then run ONE decode step per bucket

@@ -1,4 +1,5 @@
-"""Serving observability: counters + per-request latencies through EventLog.
+"""Serving observability: counters + per-request latencies through EventLog
+and the process metrics registry.
 
 Every record goes to the engine's :class:`~marlin_tpu.utils.tracing.EventLog`
 (or the process default, resolved per emit so a log installed mid-run is
@@ -9,9 +10,9 @@ picked up) under the single kind ``"serve"`` with an ``ev`` discriminator:
 =============  ===========================================================
 ``enqueue``    ``rid``, ``bucket``, ``depth`` (queue depth after admit)
 ``reject``     ``rid``, ``reason``
-``prefill``    row-level scheduling, one per slot prefill: ``bucket``,
-               ``new_tokens`` (1 — the row's first token lands here),
-               ``seconds`` (prefill wall time)
+``prefill``    row-level scheduling, one per slot prefill: ``rid``,
+               ``bucket``, ``new_tokens`` (1 — the row's first token lands
+               here), ``seconds`` (prefill wall time)
 ``batch``      gang scheduling, one per dispatched batch: ``bucket``,
                ``rows`` (live), ``occupancy`` (live/max_batch),
                ``new_tokens``, ``seconds`` (wall), ``tok_s``
@@ -25,6 +26,20 @@ picked up) under the single kind ``"serve"`` with an ``ev`` discriminator:
                ``total_s``
 =============  ===========================================================
 
+The engine activates each request's span context around the rid-carrying
+emits, so one request's ``enqueue``/``prefill``/``result`` records share a
+``trace_id`` in the JSONL (obs/trace.py; the analyzer joins them).
+
+In parallel, everything aggregates into the process registry
+(:mod:`marlin_tpu.obs.metrics`) so a ``/metrics`` scrape sees live serving
+state: ``marlin_serve_submitted_total``,
+``marlin_serve_requests_total{status=...}``, ``marlin_serve_tokens_total``,
+``marlin_serve_dispatches_total{kind=batch|step|prefill}``,
+``marlin_serve_busy_seconds_total``, gauges ``marlin_serve_queue_depth`` /
+``marlin_serve_slot_occupancy`` / ``marlin_serve_kv_inflight_bytes``, and
+histograms ``marlin_serve_ttft_seconds`` / ``marlin_serve_total_seconds`` /
+``marlin_serve_step_seconds``.
+
 Latencies are measured on the engine's *injected* clock (deterministic
 tests), throughput (``tok_s``) on the real wall clock (it is a measurement,
 not a policy input). Under gang scheduling a row's first token becomes
@@ -34,27 +49,51 @@ token lands with the slot's prefill, so ``ttft_s`` is genuinely earlier —
 the headline latency the row-level split buys (docs/serving.md).
 
 :meth:`ServeMetrics.snapshot` aggregates everything for tests and the bench
-(`bench_all.py serve`) without re-reading the log file.
+(`bench_all.py serve`) without re-reading the log file. Its percentiles run
+over *uniform reservoir samples* (:class:`Reservoir`, Algorithm R with an
+injectable RNG) — the previous first-``keep_latencies``-then-drop scheme
+silently stopped sampling after warmup, biasing every long-run percentile
+toward the coldest requests the engine ever served.
 """
 
 from __future__ import annotations
 
-import math
+import random
 import threading
 
+from ..obs.metrics import get_registry, percentile  # noqa: F401  (re-export)
 from ..utils.tracing import get_default_event_log
 
-__all__ = ["ServeMetrics", "percentile"]
+__all__ = ["ServeMetrics", "Reservoir", "percentile"]
 
 
-def percentile(values, q: float) -> float:
-    """Nearest-rank percentile (q in [0, 100]) of a non-empty list — tiny and
-    dependency-free so the bench and tests share one definition."""
-    xs = sorted(values)
-    if not xs:
-        raise ValueError("percentile of empty list")
-    i = max(0, min(len(xs) - 1, math.ceil(q / 100.0 * len(xs)) - 1))
-    return xs[i]
+class Reservoir:
+    """Uniform reservoir sampling (Algorithm R): after ``n`` adds, each of
+    the ``n`` values had probability ``k/n`` of being retained — percentiles
+    over the sample estimate the whole stream, not its first ``k`` entries.
+    The RNG is injectable (tests pin it; callers share one across
+    reservoirs). NOT thread-safe on its own — :class:`ServeMetrics` adds
+    under its lock."""
+
+    __slots__ = ("k", "n", "items", "_rng")
+
+    def __init__(self, k: int, rng: random.Random):
+        self.k = int(k)
+        self.n = 0
+        self.items: list[float] = []
+        self._rng = rng
+
+    def add(self, value: float) -> None:
+        self.n += 1
+        if len(self.items) < self.k:
+            self.items.append(value)
+        else:
+            j = self._rng.randrange(self.n)
+            if j < self.k:
+                self.items[j] = value
+
+    def values(self) -> list[float]:
+        return list(self.items)
 
 
 class ServeMetrics:
@@ -62,10 +101,10 @@ class ServeMetrics:
     are called by the engine (submit path + worker thread) — never raise out
     of them into the serving path."""
 
-    def __init__(self, log=None, keep_latencies: int = 4096):
+    def __init__(self, log=None, keep_latencies: int = 4096, rng=None):
         self._log = log
         self._lock = threading.Lock()
-        self._keep = keep_latencies
+        rng = rng if rng is not None else random.Random(0)
         self.submitted = 0
         self.rejected = 0
         self.expired = 0
@@ -78,24 +117,66 @@ class ServeMetrics:
         self.busy_s = 0.0
         self._occupancy_sum = 0.0
         self._step_occupancy_sum = 0.0
-        self._total_s: list[float] = []
-        self._queue_s: list[float] = []
-        self._ttft_s: list[float] = []
-        self._step_s: list[float] = []
+        self._total_s = Reservoir(keep_latencies, rng)
+        self._queue_s = Reservoir(keep_latencies, rng)
+        self._ttft_s = Reservoir(keep_latencies, rng)
+        self._step_s = Reservoir(keep_latencies, rng)
+        reg = get_registry()
+        self._m_submitted = reg.counter(
+            "marlin_serve_submitted_total", "Requests admitted by submit()")
+        self._m_requests = reg.counter(
+            "marlin_serve_requests_total",
+            "Terminal request outcomes by status",
+            labelnames=("status",))
+        self._m_tokens = reg.counter(
+            "marlin_serve_tokens_total", "Generated tokens (all requests)")
+        self._m_dispatch = reg.counter(
+            "marlin_serve_dispatches_total",
+            "Engine dispatches by kind (gang batch / row-level decode step "
+            "/ slot prefill)", labelnames=("kind",))
+        self._m_busy = reg.counter(
+            "marlin_serve_busy_seconds_total",
+            "Wall seconds the engine spent inside compiled programs")
+        self._m_queue_depth = reg.gauge(
+            "marlin_serve_queue_depth",
+            "Requests admitted but not yet retired (queued + in flight)")
+        self._m_occupancy = reg.gauge(
+            "marlin_serve_slot_occupancy",
+            "Live rows / max_batch of the most recent dispatch")
+        self._m_kv_bytes = reg.gauge(
+            "marlin_serve_kv_inflight_bytes",
+            "Admitted-but-unretired KV-cache bytes against the planner's "
+            "HBM budget")
+        self._m_ttft = reg.histogram(
+            "marlin_serve_ttft_seconds", "Time to first generated token")
+        self._m_total = reg.histogram(
+            "marlin_serve_total_seconds", "Submit-to-result latency")
+        self._m_step = reg.histogram(
+            "marlin_serve_step_seconds", "Row-level decode-step wall time")
 
     def _emit(self, **fields) -> None:
         log = self._log or get_default_event_log()
         if log is not None:
             log.event("serve", **fields)
 
+    def record_queue(self, depth: int, kv_bytes: int) -> None:
+        """Live admission-gate state (the engine calls this on every admit
+        and retirement) — gauges only, no EventLog record."""
+        self._m_queue_depth.set(depth)
+        self._m_kv_bytes.set(kv_bytes)
+
     def record_enqueue(self, rid: int, bucket, depth: int) -> None:
         with self._lock:
             self.submitted += 1
+        self._m_submitted.inc()
+        # queue-depth gauge: record_queue is the single writer (the engine
+        # calls it right after, with the admission gate's own count)
         self._emit(ev="enqueue", rid=rid, bucket=list(bucket), depth=depth)
 
     def record_reject(self, rid: int, reason: str) -> None:
         with self._lock:
             self.rejected += 1
+        self._m_requests.labels(status="rejected").inc()
         self._emit(ev="reject", rid=rid, reason=reason)
 
     def record_batch(self, bucket, rows: int, max_batch: int,
@@ -105,12 +186,17 @@ class ServeMetrics:
             self.new_tokens += new_tokens
             self.busy_s += seconds
             self._occupancy_sum += rows / max_batch
+        self._m_dispatch.labels(kind="batch").inc()
+        self._m_tokens.inc(new_tokens)
+        self._m_busy.inc(seconds)
+        self._m_occupancy.set(rows / max_batch)
         self._emit(ev="batch", bucket=list(bucket), rows=rows,
                    occupancy=round(rows / max_batch, 4),
                    new_tokens=new_tokens, seconds=seconds,
                    tok_s=round(new_tokens / max(seconds, 1e-9), 2))
 
-    def record_prefill(self, bucket, seconds: float) -> None:
+    def record_prefill(self, bucket, seconds: float,
+                       rid: int | None = None) -> None:
         """One row-level slot prefill: the row's FIRST token is emitted here
         (real TTFT), so it counts toward ``new_tokens``/``busy_s`` — without
         this, steps=1 traffic would report zero tokens and every request
@@ -118,8 +204,14 @@ class ServeMetrics:
         with self._lock:
             self.new_tokens += 1
             self.busy_s += seconds
-        self._emit(ev="prefill", bucket=list(bucket), new_tokens=1,
-                   seconds=seconds)
+        self._m_dispatch.labels(kind="prefill").inc()
+        self._m_tokens.inc()
+        self._m_busy.inc(seconds)
+        fields = {"ev": "prefill", "bucket": list(bucket), "new_tokens": 1,
+                  "seconds": seconds}
+        if rid is not None:
+            fields["rid"] = rid
+        self._emit(**fields)
 
     def record_step(self, bucket, rows: int, max_batch: int,
                     seconds: float) -> None:
@@ -130,8 +222,12 @@ class ServeMetrics:
             self.new_tokens += rows
             self.busy_s += seconds
             self._step_occupancy_sum += rows / max_batch
-            if len(self._step_s) < self._keep:
-                self._step_s.append(seconds)
+            self._step_s.add(seconds)
+        self._m_dispatch.labels(kind="step").inc()
+        self._m_tokens.inc(rows)
+        self._m_busy.inc(seconds)
+        self._m_occupancy.set(rows / max_batch)
+        self._m_step.observe(seconds)
         self._emit(ev="step", bucket=list(bucket), rows=rows,
                    occupancy=round(rows / max_batch, 4), new_tokens=rows,
                    seconds=seconds,
@@ -150,10 +246,10 @@ class ServeMetrics:
                 self.errors += 1
             elif status == "shutting_down":
                 self.shut_down += 1
-            if total_s is not None and len(self._total_s) < self._keep:
-                self._total_s.append(total_s)
-            if queue_s is not None and len(self._queue_s) < self._keep:
-                self._queue_s.append(queue_s)
+            if total_s is not None:
+                self._total_s.add(total_s)
+            if queue_s is not None:
+                self._queue_s.add(queue_s)
             # ttft falls back to total_s ONLY for completed gang results
             # (their first token really does surface with the whole batch);
             # expired/error requests never produced a token, and counting
@@ -161,8 +257,13 @@ class ServeMetrics:
             # percentile the row-level A/B measures
             if ttft_s is None and status == "ok":
                 ttft_s = total_s
-            if ttft_s is not None and len(self._ttft_s) < self._keep:
-                self._ttft_s.append(ttft_s)
+            if ttft_s is not None:
+                self._ttft_s.add(ttft_s)
+        self._m_requests.labels(status=status).inc()
+        if total_s is not None:
+            self._m_total.observe(total_s)
+        if ttft_s is not None:
+            self._m_ttft.observe(ttft_s)
         fields = {"ev": "result", "rid": rid, "status": status}
         if bucket is not None:
             fields["bucket"] = list(bucket)
@@ -177,12 +278,13 @@ class ServeMetrics:
     def snapshot(self) -> dict:
         """One aggregate dict: counters plus occupancy mean (over gang
         batches and row-level decode steps alike), tokens/s over engine busy
-        time, and p50/p99 total / ttft latency (None until data)."""
+        time, and p50/p99 total / ttft latency (None until data; percentiles
+        over the uniform reservoirs)."""
         with self._lock:
-            lat = list(self._total_s)
-            qs = list(self._queue_s)
-            tt = list(self._ttft_s)
-            ss = list(self._step_s)
+            lat = self._total_s.values()
+            qs = self._queue_s.values()
+            tt = self._ttft_s.values()
+            ss = self._step_s.values()
             dispatches = self.batches + self.steps
             occ = self._occupancy_sum + self._step_occupancy_sum
             out = {

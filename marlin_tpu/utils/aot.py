@@ -25,6 +25,7 @@ the same jitted program the runtime path executes.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import numpy as np
@@ -40,9 +41,18 @@ def tpu_topology(topology_name: str = "v5e:2x2"):
 
     Requires libtpu (the compiler) to be importable; raises RuntimeError with
     the underlying cause otherwise — callers that want to skip instead gate on
-    :func:`supports_aot_tpu`."""
+    :func:`supports_aot_tpu`.
+
+    The probe runs with ``TPU_SKIP_MDS_QUERY=1`` (restored afterwards unless
+    the caller already set it): a compile-only topology needs no instance
+    metadata, and on hosts without a TPU runtime libtpu's PJRT plugin init
+    otherwise blocks the process — GIL held — retrying GCP metadata fetches
+    (30 tries per variable), which hangs any caller, including the test
+    suite's collection-time skipif gate."""
     from jax.experimental import topologies
 
+    had = "TPU_SKIP_MDS_QUERY" in os.environ
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
     try:
         return topologies.get_topology_desc(
             platform="tpu", topology_name=topology_name)
@@ -50,6 +60,9 @@ def tpu_topology(topology_name: str = "v5e:2x2"):
         raise RuntimeError(
             f"compile-only TPU topology {topology_name!r} unavailable: {e}"
         ) from e
+    finally:
+        if not had:
+            os.environ.pop("TPU_SKIP_MDS_QUERY", None)
 
 
 def supports_aot_tpu() -> bool:

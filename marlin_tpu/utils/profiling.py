@@ -48,11 +48,22 @@ def evaluate(*xs):
 
 @contextlib.contextmanager
 def timer(label: str = "", results: list | None = None, quiet: bool = False):
+    """Wall-clock the body, print millis like the reference's examples do —
+    and, when a default :class:`~marlin_tpu.utils.tracing.EventLog` is
+    installed, land the same timing there as a ``kind="timer"`` record
+    (with the active trace context), so example/bench timings are part of
+    the post-mortem stream instead of scrollback-only."""
     t0 = time.perf_counter()
     yield
     dt_ms = (time.perf_counter() - t0) * 1000.0
     if results is not None:
         results.append(dt_ms)
+    from .tracing import get_default_event_log
+
+    log = get_default_event_log()
+    if log is not None:
+        log.event("timer", label=label or "elapsed",
+                  seconds=round(dt_ms / 1e3, 6))
     if not quiet:
         print(f"{label or 'elapsed'}: {dt_ms:.1f} ms")
 
@@ -85,6 +96,27 @@ class StepTimer:
         )
 
 
+_stage_families = None  # lazy (registry import stays off the module path)
+
+
+def _stage_metrics():
+    global _stage_families
+    if _stage_families is None:
+        from ..obs.metrics import get_registry
+
+        reg = get_registry()
+        _stage_families = (
+            reg.counter("marlin_stage_seconds_total",
+                        "Wall-clock accumulated per pipeline stage "
+                        "(StageTimes: produce/transfer/stall/compute/drain)",
+                        labelnames=("stage",)),
+            reg.counter("marlin_stage_events_total",
+                        "StageTimes samples per pipeline stage",
+                        labelnames=("stage",)),
+        )
+    return _stage_families
+
+
 class StageTimes:
     """Aggregate wall-clock by named stage across threads.
 
@@ -93,7 +125,9 @@ class StageTimes:
     the consumer waited on the queue — the *un-overlapped* producer latency,
     ~0 when prefetch is keeping up), ``compute`` (device dispatch) and
     ``drain`` (blocking D2H fetches). Producer threads and the consumer write
-    concurrently, hence the lock."""
+    concurrently, hence the lock. Every sample also lands in the process
+    metrics registry (``marlin_stage_seconds_total{stage=...}``), so stage
+    budgets are scrapeable, not just printable."""
 
     def __init__(self):
         self.seconds: dict[str, float] = {}
@@ -104,6 +138,9 @@ class StageTimes:
         with self._lock:
             self.seconds[stage] = self.seconds.get(stage, 0.0) + seconds
             self.counts[stage] = self.counts.get(stage, 0) + 1
+        secs, events = _stage_metrics()
+        secs.labels(stage=stage).inc(seconds)
+        events.labels(stage=stage).inc()
 
     @contextlib.contextmanager
     def timed(self, stage: str):

@@ -19,35 +19,17 @@ import marlin_tpu as mt
 
 # ---------------------------------------------------------------- compiles
 
-class _CompileTally:
-    """Process-wide XLA backend-compile counter fed by a jax.monitoring
-    listener (registered once, lazily — jax.monitoring offers no selective
-    unregister, so a per-test listener would accumulate forever)."""
-
-    count = 0
-    registered = False
-
-    @classmethod
-    def ensure_registered(cls):
-        if cls.registered:
-            return
-        from jax import monitoring
-
-        def _on_duration(event, duration, **kw):
-            if event == "/jax/core/compile/backend_compile_duration":
-                cls.count += 1  # GIL-atomic; fires from any compiling thread
-
-        monitoring.register_event_duration_secs_listener(_on_duration)
-        cls.registered = True
-
-
 class _CompileCount:
     def __init__(self):
-        self._start = _CompileTally.count
+        from marlin_tpu.obs import collectors
+
+        self._start = collectors.compile_count()
 
     @property
     def count(self) -> int:
-        return _CompileTally.count - self._start
+        from marlin_tpu.obs import collectors
+
+        return collectors.compile_count() - self._start
 
 
 @pytest.fixture()
@@ -57,8 +39,15 @@ def compile_count():
     assert c.count <= bound``. Counts every backend compile in the process
     (any thread — serving workers included), so scope the block tightly and
     warm auxiliary one-time programs (PRNG key creation, dtype converts)
-    before asserting an exact bound."""
-    _CompileTally.ensure_registered()
+    before asserting an exact bound.
+
+    The tally itself lives in the library now
+    (``marlin_tpu.obs.collectors.install_compile_metrics`` — the
+    jax.monitoring bridge that also feeds ``marlin_compile_total``), so
+    production runs see the same signal this fixture guards in tests."""
+    from marlin_tpu.obs import collectors
+
+    collectors.install_compile_metrics()
 
     @contextlib.contextmanager
     def guard():
@@ -68,9 +57,10 @@ def compile_count():
 
 
 # worker-thread name prefixes owned by the library; each subsystem joins its
-# workers on close (ChunkPrefetcher.close, ServeEngine.drain/close), so any
-# survivor after a test is a leak in that test or that subsystem
-_WORKER_PREFIXES = ("marlin-prefetch", "marlin-serve")
+# workers on close (ChunkPrefetcher.close, ServeEngine.drain/close,
+# MetricsServer.close), so any survivor after a test is a leak in that test
+# or that subsystem
+_WORKER_PREFIXES = ("marlin-prefetch", "marlin-serve", "marlin-obs")
 
 
 def _worker_threads():

@@ -58,15 +58,29 @@ def log(*a):
 RESULTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_ALL.json")
 
 
-def record(name, value, unit, detail=""):
+def record(name, value, unit, detail="", extra=None):
     # 2 decimals for human-scale values; 3 significant digits below that so
     # rel-err records (~1e-6) don't round to a meaningless 0.0
     rounded = round(value, 2) if abs(value) >= 0.01 else float(f"{value:.3g}")
     stamp = f"{ROUND} {time.strftime('%Y-%m-%d')}".strip()
     entry = {"config": name, "value": rounded, "unit": unit, "detail": detail,
              "measured": stamp}
+    if extra:  # ride-along fields (e.g. roofline_frac) — tools/bench_compare
+        entry.update(extra)  # shows them next to the gated value
     RESULTS.append(entry)
     print(json.dumps(entry), flush=True)
+
+
+def _roofline_extra(flops, nbytes, seconds):
+    """{"roofline_frac": ...} for one measured program, or None where peaks
+    are unknown — BENCH rounds track utilization next to throughput
+    (obs/perf.py; CPU peaks are nominal placeholders, TPU peaks are the
+    generation table / config overrides)."""
+    from marlin_tpu.obs import perf
+
+    pf, bw = perf.peak_rates()
+    frac = perf.roofline(flops, nbytes, seconds, pf, bw)["roofline_frac"]
+    return {"roofline_frac": round(frac, 4)} if frac is not None else None
 
 
 def sync(x):
@@ -112,8 +126,10 @@ def _dense_config(n, reps, name, precision="high"):
         c = a.multiply(b, precision=precision)
     float(jnp.sum(c.data))
     dt = (time.perf_counter() - t0) / reps
+    itemsize = jnp.dtype(a.data.dtype).itemsize
     record(name, 2 * n**3 / dt / 1e9, "GFLOP/s",
-           f"{dt * 1e3:.1f} ms/multiply, precision={precision}")
+           f"{dt * 1e3:.1f} ms/multiply, precision={precision}",
+           extra=_roofline_extra(2.0 * n**3, 3.0 * n * n * itemsize, dt))
 
 
 def config4():
@@ -156,7 +172,9 @@ def config4():
     record(f"4_tall_skinny_{rows}x512_gramian_e2e",
            2 * rows * cols**2 / dt / 1e9, "GFLOP/s",
            f"{dt:.1f} s end-to-end incl. host generation + H2D transfer "
-           f"[{mode}; stages: {stats.summary()}]")
+           f"[{mode}; stages: {stats.summary()}]",
+           extra=_roofline_extra(2.0 * rows * cols**2,
+                                 4.0 * rows * cols, dt))
 
     # device-compute half of the split: the same per-chunk rank-update with
     # the operand already resident, sync-amortized over reps — what the
@@ -732,6 +750,32 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     try:
         for rate in rates:
             run_rate(rate)
+        # ---- decode-program roofline: the serve sweep's utilization record
+        # (ISSUE 6 acceptance: BENCH rounds track utilization, not just
+        # tok/s). The cost model came from warmup's capture, the timings
+        # from the engines' live decode steps across all rates.
+        from marlin_tpu.obs import perf as obs_perf
+
+        # gang mode never runs lm_decode_rows — its decode program is the
+        # fused batch generate, so the gang control reads that instead
+        decode_prog = "lm_decode_rows" if rowlevel else "lm_generate_batch"
+        decode_rows = [r for r in obs_perf.get_program_costs().rows()
+                       if r["program"] == decode_prog and r["calls"]
+                       and r["roofline_frac"] is not None]
+        if decode_rows:
+            r = max(decode_rows, key=lambda r: r["calls"])
+            # frac can be non-None while achieved/peak_flops are (bandwidth
+            # roofline, bytes-only cost model) — format each defensively
+            ach = (f"{r['achieved_flops_per_s'] / 1e9:.2f} GFLOP/s"
+                   if r["achieved_flops_per_s"] else "n/a")
+            peak = (f"peak {r['peak_flops'] / 1e12:.1f} TFLOP/s"
+                    if r["peak_flops"] else "the bandwidth roofline")
+            record("serve_decode_roofline" + ("" if rowlevel else "_gang"),
+                   r["roofline_frac"], "frac",
+                   f"{decode_prog}[{r['key']}]: {ach} achieved over "
+                   f"{r['calls']} dispatches vs {peak} "
+                   f"(marlin_program_roofline_frac live on /metrics)",
+                   extra={"roofline_frac": round(r["roofline_frac"], 4)})
     finally:
         # a mid-sweep failure must not leak the default log / endpoint into
         # the rest of the bench process (main() catches and keeps sweeping)
@@ -743,7 +787,8 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     want = ("marlin_serve_submitted_total", "marlin_serve_queue_depth",
             "marlin_serve_slot_occupancy", "marlin_serve_kv_inflight_bytes",
             "marlin_compile_total", "marlin_prefetch_chunks_total",
-            "marlin_device_memory_bytes_in_use")
+            "marlin_device_memory_bytes_in_use",
+            "marlin_program_roofline_frac")
     got = [n for n in want if f"# TYPE {n} " in scrape]
     # same "trace-joined" definition as python -m marlin_tpu.obs.report
     from marlin_tpu.obs.report import trace_join

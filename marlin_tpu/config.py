@@ -127,6 +127,24 @@ class MarlinConfig:
     # runs, not for a long-running serve loop flushing per event. Per-log
     # override: EventLog(..., max_bytes=...).
     obs_log_max_bytes: int = 0
+    # Roofline peak rates (obs/perf.py): FLOP/s and HBM bytes/s the
+    # achieved-performance fractions are computed against. None = detect
+    # from the device kind (the TPU-generation table in obs/perf.py; CPU
+    # backends get documented *nominal* placeholders) — set both explicitly
+    # when the table's number disagrees with your part's datasheet.
+    obs_peak_flops: float | None = None
+    obs_peak_bw: float | None = None
+    # Where on-demand profiler captures (obs.perf.capture_profile, the
+    # /debug/profile endpoint, SIGUSR2) and flight-recorder dumps land.
+    # None = <tempdir>/marlin_tpu_captures. The directory rotates: captures
+    # beyond obs_profile_cap_bytes total are pruned oldest-first.
+    obs_profile_dir: str | None = None
+    obs_profile_cap_bytes: int = 256 << 20
+    # Step-time flight recorder ring length (obs.perf.FlightRecorder): the
+    # last N per-iteration records kept in memory per recorder (serving
+    # worker loop, prefetch producers), dumped to JSONL on worker faults /
+    # engine close / GET /debug/flight.
+    obs_flight_len: int = 256
 
 
 _config = MarlinConfig()

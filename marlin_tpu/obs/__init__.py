@@ -18,6 +18,11 @@ ServeMetrics counters, StageTimes, a test-only compile tally):
   trace in the JSONL.
 - :mod:`~marlin_tpu.obs.report` — the post-hoc analyzer
   (``python -m marlin_tpu.obs.report events.jsonl``).
+- :mod:`~marlin_tpu.obs.perf` — performance introspection: per-program
+  roofline accounting (XLA cost models joined with measured wall times →
+  ``marlin_program_*`` series and the analyzer's utilization table), the
+  single-flight on-demand profiler capture (``POST /debug/profile``,
+  SIGUSR2), and the step-time flight recorder (``GET /debug/flight``).
 
 docs/observability.md walks the whole surface.
 """
@@ -33,7 +38,8 @@ from .metrics import (  # noqa: F401
 )
 from .exposition import MetricsServer, start_from_config  # noqa: F401
 from . import collectors  # noqa: F401  (imports utils.tracing lazily)
+from . import perf  # noqa: F401  (imports jax lazily)
 
-__all__ = ["trace", "collectors", "Counter", "Gauge", "Histogram",
+__all__ = ["trace", "collectors", "perf", "Counter", "Gauge", "Histogram",
            "MetricsRegistry", "get_registry", "percentile", "MetricsServer",
            "start_from_config"]

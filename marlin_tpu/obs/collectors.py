@@ -163,12 +163,16 @@ def log_device_memory(log=None, **fields) -> None:
 def install_default_collectors(registry: MetricsRegistry | None = None,
                                ) -> None:
     """Everything a scrape endpoint should carry: the compile bridge, the
-    device-memory/planner gauges, and the prefetch family pre-registration
-    (so a serving-only process still exposes the prefetch series at zero
-    instead of omitting them)."""
+    device-memory/planner gauges, the program-cost/roofline collector
+    (obs/perf.py — ``marlin_program_*``), and the prefetch family
+    pre-registration (so a serving-only process still exposes the prefetch
+    series at zero instead of omitting them)."""
     reg = registry if registry is not None else get_registry()
     install_compile_metrics(reg)
     install_device_memory_gauges(reg)
+    from .perf import install_program_costs
+
+    install_program_costs(reg)
     if reg is get_registry():
         # prefetch declares its families lazily on first pipeline; touch
         # them so the series exist (at zero) on processes that never stream

@@ -1,50 +1,159 @@
 """Prometheus ``/metrics`` HTTP exposition over the stdlib ``http.server``.
 
-:class:`MetricsServer` binds a loopback (by default) port and serves the
-process registry's text rendering at ``/metrics`` (plus ``/healthz`` for
-liveness probes) from one daemon thread named ``marlin-obs-http-*`` — the
-test suite's thread-leak fixture watches the prefix, and :meth:`close`
-joins it. :func:`start_from_config` is the config-driven entry: it starts
-a server when ``config.obs_http_port`` is set (0 = ephemeral port) and
-returns None when observability exposition is disabled (the default), so
-long-running entrypoints can call it unconditionally.
+:class:`MetricsServer` binds a loopback (by default) port and serves, from
+one daemon thread named ``marlin-obs-http-*`` (the test suite's thread-leak
+fixture watches the prefix; :meth:`close` joins it):
+
+- ``GET /metrics`` — the process registry's Prometheus text rendering.
+- ``GET /healthz`` — a *readiness* probe, not just liveness: registered
+  health providers (serving engines register themselves) report lifecycle
+  (accepting/draining/closed), live-slot count, queue depth, and worker
+  heartbeat age as JSON; any non-accepting engine turns the response 503 so
+  a load balancer stops routing before drain completes. With no providers
+  registered the endpoint degrades to the old static ``ok`` (process-up
+  liveness).
+- ``POST /debug/profile?seconds=N`` — a single-flight on-demand
+  ``jax.profiler`` capture (:func:`marlin_tpu.obs.perf.capture_profile`);
+  a second concurrent request gets 409 while the first records.
+- ``GET /debug/flight`` — every live flight recorder's ring as JSONL
+  (:func:`marlin_tpu.obs.perf.flight_records`), the in-memory black box
+  without waiting for a dump trigger.
+
+:func:`start_from_config` is the config-driven entry: it starts a server
+when ``config.obs_http_port`` is set (0 = ephemeral port), installs the
+SIGUSR2 profile hook, and returns None when exposition is disabled (the
+default), so long-running entrypoints call it unconditionally.
 
 Starting a server also installs the default runtime collectors
 (:func:`marlin_tpu.obs.collectors.install_default_collectors`): a scrapeable
-endpoint with no compile or device-memory series would silently re-open the
-exact blind spots this layer exists to close.
+endpoint with no compile, device-memory, or program-cost series would
+silently re-open the exact blind spots this layer exists to close.
 """
 
 from __future__ import annotations
 
 import http.server
 import itertools
+import json
+import math
 import threading
+import urllib.parse
 
 from .metrics import MetricsRegistry, get_registry
 
-__all__ = ["MetricsServer", "start_from_config"]
+__all__ = ["MetricsServer", "start_from_config", "register_health_provider",
+           "unregister_health_provider", "health_payload"]
 
 _ids = itertools.count()
+
+# ------------------------------------------------------------ health registry
+
+_health_lock = threading.Lock()
+_health_providers: dict[str, object] = {}  # name -> callable() -> dict
+
+#: provider states that flip readiness to 503 — an engine past "accepting"
+#: must drop out of rotation even while it finishes accepted work
+_NOT_READY = ("draining", "closing", "closed")
+
+
+def register_health_provider(name: str, fn) -> None:
+    """Register a readiness probe: ``fn()`` returns a small dict with at
+    least ``state`` (``accepting`` / ``draining`` / ``closed``). Serving
+    engines self-register; anything long-running may join. Re-registering a
+    name replaces the provider."""
+    with _health_lock:
+        _health_providers[name] = fn
+
+
+def unregister_health_provider(name: str) -> None:
+    with _health_lock:
+        _health_providers.pop(name, None)
+
+
+def health_payload() -> tuple[int, dict]:
+    """(status_code, body) of the readiness probe — pure over the provider
+    registry so tests exercise the 503 logic without racing a live drain.
+    A provider that raises reports ``state="error"`` (and 503s): a probe
+    that cannot answer is not ready, but must not take the endpoint down."""
+    with _health_lock:
+        providers = dict(_health_providers)
+    engines = []
+    ready = True
+    for name, fn in sorted(providers.items()):
+        try:
+            info = fn()
+            if info is None:  # provider pruned itself (e.g. GC'd engine)
+                continue
+            info = dict(info)
+        except Exception as e:
+            info = {"state": "error", "error": f"{type(e).__name__}: {e}"}
+        info.setdefault("name", name)
+        state = info.get("state")
+        if state in _NOT_READY or state == "error":
+            ready = False
+        engines.append(info)
+    return (200 if ready else 503,
+            {"status": "ok" if ready else "unavailable", "engines": engines})
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
     # the registry rides on the server object (one handler class serves
     # every MetricsServer instance)
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
-        if self.path.split("?")[0] == "/metrics":
+        path = self.path.split("?")[0]
+        if path == "/metrics":
             body = self.server._marlin_registry.render().encode()
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
-        elif self.path == "/healthz":
-            body = b"ok\n"
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self._reply(200, body,
+                        "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            code, payload = health_payload()
+            if not payload["engines"]:
+                # liveness fallback: nothing registered, process is up
+                self._reply(200, b"ok\n", "text/plain; charset=utf-8")
+            else:
+                self._reply(code, (json.dumps(payload) + "\n").encode(),
+                            "application/json")
+        elif path == "/debug/flight":
+            from .perf import flight_records
+
+            lines = "".join(json.dumps(r) + "\n" for r in flight_records())
+            self._reply(200, lines.encode(), "application/jsonl")
         else:
-            body = b"not found\n"
-            self.send_response(404)
-            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self._reply(404, b"not found\n", "text/plain; charset=utf-8")
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        parsed = urllib.parse.urlparse(self.path)
+        if parsed.path != "/debug/profile":
+            self._reply(404, b"not found\n", "text/plain; charset=utf-8")
+            return
+        from .perf import ProfileBusy, capture_profile
+
+        q = urllib.parse.parse_qs(parsed.query)
+        try:
+            seconds = float(q.get("seconds", ["2"])[0])
+        except ValueError:
+            seconds = float("nan")
+        if not math.isfinite(seconds):  # nan slides through min/max clamps
+            self._reply(400, b"seconds must be a finite number\n",
+                        "text/plain; charset=utf-8")
+            return
+        seconds = min(max(seconds, 0.0), 600.0)  # bound a typo'd capture
+        try:
+            path = capture_profile(seconds)
+        except ProfileBusy as e:
+            self._reply(409, (str(e) + "\n").encode(),
+                        "text/plain; charset=utf-8")
+            return
+        except Exception as e:
+            self._reply(500, f"{type(e).__name__}: {e}\n".encode(),
+                        "text/plain; charset=utf-8")
+            return
+        body = json.dumps({"path": path, "seconds": seconds}) + "\n"
+        self._reply(200, body.encode(), "application/json")
+
+    def _reply(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -124,7 +233,10 @@ def start_from_config(registry: MetricsRegistry | None = None,
     (None = disabled, the default; 0 = ephemeral port; otherwise the fixed
     port). Returns the running server, or None when disabled — callers in
     long-running entrypoints (benches, serving mains) invoke this
-    unconditionally and close whatever comes back."""
+    unconditionally and close whatever comes back. Also installs the
+    SIGUSR2 on-demand profiler hook (main thread only; a no-op elsewhere)
+    — the same capture the HTTP endpoint triggers, for processes reachable
+    only by signal."""
     from ..config import get_config
 
     port = get_config().obs_http_port
@@ -132,4 +244,7 @@ def start_from_config(registry: MetricsRegistry | None = None,
         return None
     server = MetricsServer(port=port, registry=registry)
     server.start()
+    from .perf import install_profile_signal
+
+    install_profile_signal()
     return server

@@ -13,6 +13,11 @@ profiler UI, no live process:
   from ``serve``/``result`` records decomposed into queue vs prefill vs
   decode time, the serving latency question ("where did the ms go?") in
   three lines.
+- **program utilization** — ``kind="program"`` records (obs/perf.py): XLA
+  cost models (``ev="cost"``) and measured-utilization snapshots
+  (``ev="util"``, emitted by engine close / streamed ops / the autotuner)
+  rendered as a roofline table — calls, achieved GFLOP/s, and the
+  fraction of the attainable rate, per compiled program and configuration.
 - **compile / memory timelines** — ``kind="compile"`` records (the
   jax.monitoring bridge) and ``kind="memory"`` samples
   (:func:`~marlin_tpu.obs.collectors.log_device_memory`) as time-offset
@@ -155,6 +160,42 @@ def _serving_section(events: list[dict]) -> list[str]:
     return out
 
 
+def _program_section(events: list[dict]) -> list[str]:
+    """The roofline table: the LAST ``ev="util"`` snapshot per
+    (program, key) — snapshots are cumulative, so the last one is the
+    run's total — plus a count of cost-only programs that never got a
+    timing joined."""
+    utils: dict[tuple, dict] = {}
+    cost_only: set = set()
+    for r in events:
+        if r.get("kind") != "program":
+            continue
+        pk = (r.get("program"), r.get("key"))
+        if r.get("ev") == "util":
+            utils[pk] = r
+        elif r.get("ev") == "cost":
+            cost_only.add(pk)
+    out = ["== program utilization =="]
+    if not utils and not cost_only:
+        out.append("(no program records — obs.perf cost capture never ran)")
+        return out
+    if utils:
+        out.append(f"{'program':<20}{'key':<36}{'calls':>7}{'GFLOP/s':>10}"
+                   f"{'roofline':>10}")
+        for (prog, key), r in sorted(utils.items()):
+            ach = r.get("achieved_flops_per_s")
+            frac = r.get("roofline_frac")
+            out.append(
+                f"{str(prog):<20}{str(key):<36}{r.get('calls', 0):>7}"
+                f"{(f'{ach / 1e9:.2f}' if ach else '-'):>10}"
+                f"{(f'{frac * 100:.2f}%' if frac is not None else '-'):>10}")
+    unmeasured = cost_only - set(utils)
+    if unmeasured:
+        out.append(f"({len(unmeasured)} program(s) with a captured cost "
+                   f"model but no joined timing)")
+    return out
+
+
 def _timeline_section(events: list[dict], t0: float) -> list[str]:
     out = []
     compiles = [r for r in events if r.get("kind") == "compile"
@@ -211,6 +252,8 @@ def analyze(events: list[dict], skipped: int = 0) -> str:
     out.extend(_trace_section(events))
     out.append("")
     out.extend(_serving_section(events))
+    out.append("")
+    out.extend(_program_section(events))
     out.append("")
     out.extend(_timeline_section(events, t0))
     return "\n".join(out) + "\n"

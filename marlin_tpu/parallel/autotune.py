@@ -197,6 +197,26 @@ def tune_multiply(mat, other, strategies=None, reps: int = 3,
     if not explicit:
         strategies = _candidates(mat, other_shape,
                                  jnp.dtype(other_dtype).itemsize)
+    # roofline substrate for strategy ranking (obs/perf.py): every timed
+    # candidate lands in the ProgramCosts registry with the multiply's
+    # analytic cost model — achieved-FLOP/s per strategy is what the
+    # autotune-over-generated-kernels direction (ROADMAP) selects on
+    from ..obs import perf
+
+    costs = perf.get_program_costs()
+    m, k = mat.shape
+    n = other_shape[1]
+    a_item = jnp.dtype(mat.data.dtype).itemsize
+    b_item = jnp.dtype(other_dtype).itemsize
+    analytic = {"flops": 2.0 * m * k * n,
+                "bytes accessed": float(m * k * a_item + k * n * b_item
+                                        + m * n * max(a_item, b_item))}
+
+    def _prog_key(s):
+        return perf.program_key(
+            strategy=s, shape=f"{m}x{k}x{n}", dtype=str(mat.data.dtype),
+            prec=precision or "config", devices=mat.mesh.devices.size)
+
     results = []
     for s in strategies:
         try:
@@ -206,7 +226,10 @@ def tune_multiply(mat, other, strategies=None, reps: int = 3,
             for _ in range(reps):
                 c = mat.multiply(other, strategy=s, precision=precision)
             evaluate(c)
-            results.append((s, (time.perf_counter() - t0) / reps))
+            elapsed = time.perf_counter() - t0
+            results.append((s, elapsed / reps))
+            costs.capture("multiply", _prog_key(s), cost=analytic)
+            costs.observe("multiply", _prog_key(s), elapsed, calls=reps)
         except UnknownStrategyError:
             # an engine rejecting the strategy name is a skippable candidate;
             # any other ValueError is a genuinely broken run (layout/shape
@@ -214,6 +237,7 @@ def tune_multiply(mat, other, strategies=None, reps: int = 3,
             continue
     if not results:
         raise ValueError("no viable multiply strategy could be timed")
+    costs.emit("multiply")  # utilization snapshots for the analyzer's table
     results.sort(key=lambda kv: kv[1])
     if not explicit:
         key = _cache_key(mat, other, precision)

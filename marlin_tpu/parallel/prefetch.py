@@ -47,7 +47,7 @@ from typing import Any, Callable, Iterable
 import jax
 
 from ..config import get_config
-from ..obs import trace as obs_trace
+from ..obs import perf, trace as obs_trace
 from ..obs.metrics import get_registry
 from ..utils import faults
 from ..utils.profiling import StageTimes
@@ -57,6 +57,22 @@ __all__ = ["ChunkPrefetcher", "prefetch_chunks"]
 _ids = itertools.count()
 
 _families = None  # lazy singleton: one set of registry families, all pipelines
+
+_flight = None  # lazy shared flight recorder: all pipelines, one black box
+_flight_lock = threading.Lock()
+
+
+def _flight_ring() -> "perf.FlightRecorder":
+    """The prefetch flight recorder (obs/perf.py): per-chunk production
+    records from every pipeline's producer threads, dumped to JSONL when a
+    producer dies so the post-mortem shows the chunks leading up to the
+    failure. Shared process-wide (pipelines are short-lived; a per-pipeline
+    ring would vanish with the object that just crashed)."""
+    global _flight
+    with _flight_lock:
+        if _flight is None:
+            _flight = perf.FlightRecorder(name="prefetch")
+        return _flight
 
 
 def _metric_families():
@@ -169,6 +185,7 @@ class ChunkPrefetcher:
                     self._finish(i)
                     return
                 except BaseException as e:  # source failure ends the stream
+                    self._flight_fault(i, "source", e)
                     self._post(i, ("err", e, 0))
                     self._finish(i + 1)
                     return
@@ -179,7 +196,11 @@ class ChunkPrefetcher:
                 if self._transform is not None:
                     chunk = self._transform(chunk)
                 nbytes = int(getattr(chunk, "nbytes", 0))
-                self.stats.add("produce", time.perf_counter() - t0)
+                produce_s = time.perf_counter() - t0
+                self.stats.add("produce", produce_s)
+                _flight_ring().record("chunk", i=i, nbytes=nbytes,
+                                      seconds=produce_s,
+                                      ready=len(self._ready))
                 if not self._wait_for_budget(i, nbytes):
                     return  # closed while waiting
                 admitted = nbytes
@@ -198,7 +219,21 @@ class ChunkPrefetcher:
                     if self._next_admit == i:
                         self._next_admit = i + 1
                     self._cv.notify_all()
+                self._flight_fault(i, "transform/upload", e)
                 self._post(i, ("err", e, 0))
+
+    @staticmethod
+    def _flight_fault(i: int, stage: str, exc: BaseException) -> None:
+        """A producer died: put the failure in the ring, then dump it —
+        the chunks leading up to this are exactly what the post-mortem
+        needs and the ring is about to stop filling. Never raises."""
+        try:
+            ring = _flight_ring()
+            ring.record("produce_error", i=i, stage=stage,
+                        error=f"{type(exc).__name__}: {exc}")
+            ring.dump(reason="producer-died")
+        except Exception:
+            pass
 
     def _wait_for_budget(self, i: int, nbytes: int) -> bool:
         """Block until chunk ``i`` may occupy the in-flight HBM budget.

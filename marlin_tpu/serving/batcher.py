@@ -47,7 +47,8 @@ import numpy as np
 
 __all__ = ["normalize_buckets", "pick_bucket", "bucket_kv_bytes",
            "BatchFormer", "SlotPool", "warmup_buckets",
-           "aot_compile_buckets"]
+           "aot_compile_buckets", "bucket_program_key",
+           "capture_bucket_costs"]
 
 Bucket = tuple[int, int]  # (P_bucket, steps_bucket)
 
@@ -303,6 +304,110 @@ def _dummy_batch(bucket: Bucket, batch: int):
     return prompts, lengths
 
 
+def bucket_program_key(params: dict, bucket: Bucket, max_batch: int,
+                       compute_dtype=None) -> str:
+    """The roofline-accounting key for one bucket's compiled programs
+    (obs/perf.py). Capture sites (warmup/AOT/pool creation) and measurement
+    sites (the engine's step/prefill timings) MUST both build the key here,
+    or the cost/timing join silently misses."""
+    import jax.numpy as jnp
+
+    from ..obs import perf
+
+    p, s = bucket
+    dt = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
+    # the model geometry is part of the program identity: two models with
+    # the same bucket/width/dtype compile different programs with different
+    # costs, and their entries must not collide
+    v, d = params["emb"].shape
+    try:
+        from ..models.transformer import _n_layers
+
+        layers = _n_layers(params)
+    except Exception:
+        layers = "?"
+    return perf.program_key(bucket=f"{p}x{s}", rows=max_batch, dtype=dt.name,
+                            model=f"v{v}d{d}l{layers}")
+
+
+def capture_bucket_costs(params: dict, heads: int, bucket: Bucket,
+                         max_batch: int, compute_dtype: str | None = None,
+                         moe: tuple | None = None,
+                         rowlevel: bool | None = None,
+                         key: str | None = None) -> None:
+    """Capture the XLA cost model (flops, bytes accessed) of a bucket's
+    compiled program(s) into the process :class:`~marlin_tpu.obs.perf
+    .ProgramCosts` registry — trace + lower only (no backend compile; the
+    bucket's real compile already happened or is about to through the jit
+    cache). Gated per (program, bucket key) so repeated calls — the engine
+    invokes this on every pool creation and gang dispatch — cost two dict
+    lookups after the first. Callers on the dispatch path pass their cached
+    ``key`` (the engine's ``_prog_key``) so the gate really is that cheap —
+    rebuilding it walks the params tree. Never raises: cost capture is
+    observability and must not fail warmup or a dispatch."""
+    import jax
+
+    from ..config import get_config
+    from ..obs import perf
+
+    if rowlevel is None:
+        rowlevel = get_config().serve_rowlevel
+    costs = perf.get_program_costs()
+    if key is None:
+        key = bucket_program_key(params, bucket, max_batch, compute_dtype)
+    programs = (("lm_prefill_slot", "lm_decode_rows") if rowlevel
+                else ("lm_generate_batch",))
+    # gate on attempted, not succeeded: a backend without cost_analysis()
+    # must not re-pay this trace+lower on every gang dispatch
+    if all(costs.tried(name, key) for name in programs):
+        return
+    import jax.numpy as jnp
+
+    from ..models.transformer import (_lm_decode_rows_jit,
+                                      _lm_generate_batch_jit,
+                                      _lm_prefill_slot_jit, init_kv_slab)
+
+    def st(shape, dtype=jnp.int32):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    sds = lambda tree: jax.tree.map(  # noqa: E731
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype), tree)
+    p, s = bucket
+    try:
+        if rowlevel:
+            caches = sds(jax.eval_shape(
+                lambda pp: init_kv_slab(pp, max_batch, p + s, heads,
+                                        compute_dtype), params))
+            tokens = st((max_batch, p + s))
+            pre = _lm_prefill_slot_jit.trace(
+                sds(params), caches, tokens, st(()), st((p,)), st(()),
+                st((), jnp.uint32), st((), jnp.float32),
+                st((), jnp.float32), st(()), heads=heads, max_len=p + s,
+                compute_dtype=compute_dtype, moe=moe).lower()
+            dec = _lm_decode_rows_jit.trace(
+                sds(params), caches, tokens, st((max_batch,)),
+                st((max_batch,)), st((max_batch,), jnp.uint32),
+                st((max_batch,), jnp.float32),
+                st((max_batch,), jnp.float32), st((max_batch,)),
+                heads=heads, max_len=p + s, compute_dtype=compute_dtype,
+                moe=moe).lower()
+            costs.capture("lm_prefill_slot", key, lowered=pre)
+            costs.capture("lm_decode_rows", key, lowered=dec)
+        else:
+            lo = _lm_generate_batch_jit.trace(
+                sds(params), st((max_batch, p)), st((max_batch,)),
+                sds(jax.eval_shape(jax.random.key, 0)),
+                heads=heads, max_len=p + s, steps=s,
+                temperature=st((), jnp.float32),
+                compute_dtype=compute_dtype, top_p=st((), jnp.float32),
+                use_top_p=False, top_k=None, moe=moe).lower()
+            costs.capture("lm_generate_batch", key, lowered=lo)
+    except Exception:
+        # even a failed trace marks the attempt — never retry per dispatch
+        for name in programs:
+            costs.capture(name, key)
+
+
 def warmup_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
                    max_batch: int, compute_dtype: str | None = None,
                    moe: tuple | None = None,
@@ -328,6 +433,10 @@ def warmup_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
     for bucket in buckets:
         p, s = bucket
         prompts, lengths = _dummy_batch(bucket, max_batch)
+        # roofline accounting: the bucket's XLA cost model lands in the
+        # process ProgramCosts registry alongside the warmup compile
+        capture_bucket_costs(params, heads, bucket, max_batch,
+                             compute_dtype, moe, rowlevel=rowlevel)
         if rowlevel:
             from ..models.transformer import lm_decode_rows, lm_prefill_slot
 
@@ -409,9 +518,14 @@ def aot_compile_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
     def st(shape, dtype=jnp.int32):
         return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
 
+    from ..obs import perf
+
+    costs = perf.get_program_costs()
     out = {}
     for bucket in normalize_buckets(buckets):
         p, s = bucket
+        prog_key = bucket_program_key(params, bucket, max_batch,
+                                      compute_dtype)
         with config_context(pallas_interpret=False):
             if rowlevel:
                 # derive the slab structs from init_kv_slab itself (the one
@@ -434,6 +548,10 @@ def aot_compile_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
                     st((max_batch,), jnp.float32), st((max_batch,)),
                     heads=heads, max_len=p + s, compute_dtype=compute_dtype,
                     moe=moe).lower().compile()
+                # the compiled objects carry BOTH analyses — richest
+                # capture the registry gets (memory_analysis included)
+                costs.capture("lm_prefill_slot", prog_key, compiled=pre)
+                costs.capture("lm_decode_rows", prog_key, compiled=dec)
                 out[bucket] = max(_peak_bytes(pre.memory_analysis()),
                                   _peak_bytes(dec.memory_analysis()))
             else:
@@ -445,5 +563,7 @@ def aot_compile_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
                     temperature=args[4], compute_dtype=compute_dtype,
                     top_p=args[5], use_top_p=False, top_k=None,
                     moe=moe).lower().compile()
+                costs.capture("lm_generate_batch", prog_key,
+                              compiled=compiled)
                 out[bucket] = _peak_bytes(compiled.memory_analysis())
     return out

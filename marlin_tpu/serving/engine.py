@@ -55,14 +55,19 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import weakref
 
 import numpy as np
 
 from ..config import get_config
-from ..obs import trace as obs_trace
+from ..obs import perf, trace as obs_trace
+from ..obs.collectors import compile_count as _compile_count
+from ..obs.exposition import (register_health_provider,
+                              unregister_health_provider)
 from ..utils import faults
-from .batcher import (BatchFormer, bucket_kv_bytes, normalize_buckets,
-                      pick_bucket, warmup_buckets)
+from .batcher import (BatchFormer, bucket_kv_bytes, bucket_program_key,
+                      capture_bucket_costs, normalize_buckets, pick_bucket,
+                      warmup_buckets)
 from .metrics import ServeMetrics
 from .request import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK,
                       STATUS_REJECTED, STATUS_SHUTTING_DOWN, AdmissionQueue,
@@ -158,9 +163,35 @@ class ServeEngine:
                                    max_wait=float(wait_ms) / 1e3)
         self._state = "running"  # running | draining | closing | closed
         self._started = False
+        eid = next(_engine_ids)
+        self._name = f"marlin-serve-{eid}"
         self._thread = threading.Thread(
-            target=self._run, daemon=True,
-            name=f"marlin-serve-{next(_engine_ids)}")
+            target=self._run, daemon=True, name=self._name)
+        # --- performance introspection (obs/perf.py) -----------------------
+        # the step-time black box: per-iteration records from the worker
+        # loop, dumped on worker faults, on close, and via GET /debug/flight
+        self.flight = perf.FlightRecorder(name=self._name)
+        self._heartbeat: float | None = None  # real clock; worker stamps it
+        self._live_rows = 0                   # worker-written, healthz-read
+        self._prog_keys: dict[tuple, str] = {}
+        self._finalized = False
+        # readiness: /healthz reports this engine's lifecycle and 503s once
+        # it leaves "accepting" (weakref — the provider must never pin a
+        # dead engine; terminal close/drain unregister explicitly)
+        ref = weakref.ref(self)
+        name = self._name
+
+        def _health():
+            eng = ref()
+            if eng is None:
+                # abandoned without close(): drop out silently — a dead
+                # entry must not 503 an otherwise healthy process for one
+                # probe (health_payload skips None)
+                unregister_health_provider(name)
+                return None
+            return eng._health_info()
+
+        register_health_provider(name, _health)
         if start:
             self.start()
 
@@ -186,6 +217,60 @@ class ServeEngine:
         """Requests admitted but not yet retired (queued + in flight)."""
         return self._queue.count
 
+    # ------------------------------------------------------- introspection
+
+    def _health_info(self) -> dict:
+        """The /healthz readiness payload for this engine: lifecycle state
+        (``accepting`` while running), live slot rows, queue depth, and the
+        worker heartbeat age (None until the worker's first iteration).
+        Lock-free reads of GIL-atomic fields — the probe must never contend
+        with the worker."""
+        state = {"running": "accepting", "draining": "draining",
+                 "closing": "closed", "closed": "closed"}[self._state]
+        hb = self._heartbeat
+        return {
+            "state": state,
+            "live_slots": self._live_rows,
+            "queue_depth": self._queue.count,
+            "worker_started": self._started,
+            "heartbeat_age_s": (round(time.monotonic() - hb, 3)
+                                if hb is not None else None),
+        }
+
+    def _prog_key(self, bucket) -> str:
+        """The roofline-accounting key for this engine's programs at one
+        bucket (cached — it sits on the per-step path)."""
+        key = self._prog_keys.get(bucket)
+        if key is None:
+            key = self._prog_keys[bucket] = bucket_program_key(
+                self.params, bucket, self.max_batch, self.compute_dtype)
+        return key
+
+    def _flight_dump(self, reason: str) -> None:
+        """Dump the flight ring (never raises — rides failure paths)."""
+        try:
+            self.flight.dump(reason=reason)
+        except Exception:
+            pass
+
+    def _finalize_obs(self) -> None:
+        """Terminal observability flush (close/drain, idempotent): dump the
+        flight ring and land the program-utilization snapshots
+        (``kind="program"``/``ev="util"``) in the EventLog, then drop out
+        of the /healthz registry — a terminated engine must not hold the
+        process at 503."""
+        if self._finalized:
+            return
+        self._finalized = True
+        self._flight_dump("close")
+        try:
+            for prog in ("lm_decode_rows", "lm_prefill_slot",
+                         "lm_generate_batch"):
+                perf.get_program_costs().emit(prog)
+        except Exception:
+            pass
+        unregister_health_provider(self._name)
+
     def drain(self) -> None:
         """Graceful stop: no new admissions (rejections say "draining"), but
         everything already accepted — queued and in flight — completes.
@@ -201,6 +286,7 @@ class ServeEngine:
             self._thread.join()
         with self._cond:
             self._state = "closed"
+        self._finalize_obs()
 
     def close(self) -> None:
         """Fast stop: no new admissions, the batch in flight completes, and
@@ -221,6 +307,7 @@ class ServeEngine:
             self._thread.join()
         with self._cond:
             self._state = "closed"
+        self._finalize_obs()
 
     def __enter__(self):
         return self
@@ -302,6 +389,7 @@ class ServeEngine:
         inflight = []
         try:
             while True:
+                self._heartbeat = time.monotonic()
                 batch = None
                 with self._cond:
                     while True:
@@ -340,6 +428,7 @@ class ServeEngine:
                                   if not e.handle.done()]:
                 self._retire(e, Result(e.request.rid, STATUS_ERROR,
                                        reason="serving worker died"))
+            self._flight_dump("worker-died")
             raise
 
     def _retire(self, entry: _Entry, result: Result) -> None:
@@ -369,6 +458,7 @@ class ServeEngine:
         claimed: list[_Entry] = []
         try:
             while True:
+                self._heartbeat = time.monotonic()
                 claimed = []
                 with self._cond:
                     while True:
@@ -407,6 +497,7 @@ class ServeEngine:
                 if not e.handle.done():
                     self._retire(e, Result(e.request.rid, STATUS_ERROR,
                                            reason="serving worker died"))
+            self._flight_dump("worker-died")
             raise
 
     def _claim_rowlevel(self, pools) -> list[_Entry]:
@@ -453,6 +544,12 @@ class ServeEngine:
                         pool = pools[e.bucket] = SlotPool(
                             self.params, self.heads, e.bucket,
                             self.max_batch, self.compute_dtype)
+                        # no-warmup path: the bucket's cost model still
+                        # lands with its first (lazy) compile
+                        capture_bucket_costs(
+                            self.params, self.heads, e.bucket,
+                            self.max_batch, self.compute_dtype, self.moe,
+                            rowlevel=True, key=self._prog_key(e.bucket))
                     slot = pool.free_slots()[0]
                     prompt = np.zeros((p,), np.int32)
                     n = r.prompt.shape[0]
@@ -472,9 +569,16 @@ class ServeEngine:
                 pool.caches, pool.tokens = caches, tokens
                 pool.assign(slot, e)
                 pool.ttft_s[slot] = self._clock() - e.enq_t
-                self.metrics.record_prefill(e.bucket, wall, rid=r.rid)
+                self.metrics.record_prefill(
+                    e.bucket, wall, rid=r.rid,
+                    program_key=self._prog_key(e.bucket))
+                self.flight.record(
+                    "prefill", bucket=[p, s], slot=slot, rid=r.rid,
+                    seconds=wall, queue_depth=self._queue.count,
+                    compiles=_compile_count())
                 if r.steps == 1 or (r.eos is not None and first == r.eos):
                     self._retire_row(pool, slot, STATUS_OK, self._clock())
+        self._live_rows = sum(len(p.live_slots()) for p in pools.values())
 
     def _step_rowlevel(self, pools) -> None:
         """Retire expired live rows, then run ONE decode step per bucket
@@ -519,7 +623,12 @@ class ServeEngine:
                 self._fail_pool(pools, bucket, exc)
                 continue
             wall = time.perf_counter() - t0
-            self.metrics.record_step(bucket, len(live), self.max_batch, wall)
+            self.metrics.record_step(bucket, len(live), self.max_batch, wall,
+                                     program_key=self._prog_key(bucket))
+            self.flight.record(
+                "step", bucket=list(bucket), rows=len(live),
+                seconds=wall, queue_depth=self._queue.count,
+                compiles=_compile_count())
             now = self._clock()
             host_tokens = None  # one slab fetch shared by this step's retirees
             for i in live:
@@ -532,6 +641,7 @@ class ServeEngine:
                         host_tokens = np.asarray(pool.tokens)
                     self._retire_row(pool, i, STATUS_OK, now,
                                      host_tokens=host_tokens)
+        self._live_rows = sum(len(p.live_slots()) for p in pools.values())
 
     def _retire_row(self, pool, slot: int, status: str, now: float,
                     reason: str = "", host_tokens=None) -> None:
@@ -568,11 +678,18 @@ class ServeEngine:
         drop the pool — it is rebuilt zeroed on the next admission."""
         pool = pools[bucket]
         reason = f"decode step failed: {type(exc).__name__}: {exc}"
+        self.flight.record("decode_fault", bucket=list(bucket),
+                           rows=len(pool.live_slots()), error=reason,
+                           queue_depth=self._queue.count,
+                           compiles=_compile_count())
         now = self._clock()
         for i in pool.live_slots():
             self._retire_row(pool, i, STATUS_ERROR, now, reason=reason)
         if self._slab_lost(pool):
             pools.pop(bucket)
+        # the black box lands NOW, while the final iterations are still in
+        # the ring — the post-mortem for exactly this failure class
+        self._flight_dump("decode-step-failed")
 
     def _admit_failure(self, pools, entry: _Entry, exc: Exception) -> None:
         """A prefill died: the entry being admitted gets an error Result;
@@ -584,6 +701,10 @@ class ServeEngine:
             entry.request.rid, STATUS_ERROR, reason=reason,
             metrics={"bucket": entry.bucket, "queue_s": entry.queue_s,
                      "total_s": now - entry.enq_t}))
+        self.flight.record("prefill_fault", bucket=list(entry.bucket),
+                           rid=entry.request.rid, error=reason,
+                           queue_depth=self._queue.count,
+                           compiles=_compile_count())
         pool = pools.get(entry.bucket)
         if pool is not None and self._slab_lost(pool):
             for i in pool.live_slots():
@@ -591,6 +712,7 @@ class ServeEngine:
                                  reason=f"slab lost to a failed prefill: "
                                         f"{reason}")
             pools.pop(entry.bucket)
+        self._flight_dump("prefill-failed")
 
     @staticmethod
     def _slab_lost(pool) -> bool:
@@ -629,6 +751,10 @@ class ServeEngine:
                 live.append(e)
         if not live:
             return
+        self._live_rows = len(live)
+        capture_bucket_costs(self.params, self.heads, bucket, self.max_batch,
+                             self.compute_dtype, self.moe, rowlevel=False,
+                             key=self._prog_key(bucket))
         try:
             faults.fire("serve.step", path=f"bucket-{p}x{s}")
             # prefill the claimed slots; free slots carry inert dummy rows so
@@ -648,6 +774,9 @@ class ServeEngine:
             wall = time.perf_counter() - t0
         except Exception as exc:
             reason = f"batch failed: {type(exc).__name__}: {exc}"
+            self.flight.record("batch_fault", bucket=[p, s], rows=len(live),
+                               error=reason, queue_depth=self._queue.count,
+                               compiles=_compile_count())
             done_t = self._clock()
             for e in live:
                 self._retire(e, Result(
@@ -655,6 +784,8 @@ class ServeEngine:
                     metrics={"bucket": bucket,
                              "queue_s": dispatch_t - e.enq_t,
                              "total_s": done_t - e.enq_t}))
+            self._live_rows = 0
+            self._flight_dump("batch-failed")
             return
         done_t = self._clock()
         for i, e in enumerate(live):
@@ -666,4 +797,9 @@ class ServeEngine:
                          "ttft_s": done_t - e.enq_t,
                          "total_s": done_t - e.enq_t}))
         self.metrics.record_batch(bucket, len(live), self.max_batch,
-                                  len(live) * s, wall)
+                                  len(live) * s, wall,
+                                  program_key=self._prog_key(bucket))
+        self.flight.record("batch", bucket=[p, s], rows=len(live),
+                           seconds=wall, queue_depth=self._queue.count,
+                           compiles=_compile_count())
+        self._live_rows = 0

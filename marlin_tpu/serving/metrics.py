@@ -62,6 +62,7 @@ import random
 import threading
 
 from ..obs.metrics import get_registry, percentile  # noqa: F401  (re-export)
+from ..obs.perf import get_program_costs
 from ..utils.tracing import get_default_event_log
 
 __all__ = ["ServeMetrics", "Reservoir", "percentile"]
@@ -180,12 +181,16 @@ class ServeMetrics:
         self._emit(ev="reject", rid=rid, reason=reason)
 
     def record_batch(self, bucket, rows: int, max_batch: int,
-                     new_tokens: int, seconds: float) -> None:
+                     new_tokens: int, seconds: float,
+                     program_key: str | None = None) -> None:
         with self._lock:
             self.batches += 1
             self.new_tokens += new_tokens
             self.busy_s += seconds
             self._occupancy_sum += rows / max_batch
+        if program_key is not None:
+            get_program_costs().observe("lm_generate_batch", program_key,
+                                        seconds)
         self._m_dispatch.labels(kind="batch").inc()
         self._m_tokens.inc(new_tokens)
         self._m_busy.inc(seconds)
@@ -196,14 +201,20 @@ class ServeMetrics:
                    tok_s=round(new_tokens / max(seconds, 1e-9), 2))
 
     def record_prefill(self, bucket, seconds: float,
-                       rid: int | None = None) -> None:
+                       rid: int | None = None,
+                       program_key: str | None = None) -> None:
         """One row-level slot prefill: the row's FIRST token is emitted here
         (real TTFT), so it counts toward ``new_tokens``/``busy_s`` — without
         this, steps=1 traffic would report zero tokens and every request
-        would be undercounted by one versus the gang accounting."""
+        would be undercounted by one versus the gang accounting.
+        ``program_key`` joins the wall time onto the bucket's captured XLA
+        cost model (obs/perf.py) — the roofline side of the same record."""
         with self._lock:
             self.new_tokens += 1
             self.busy_s += seconds
+        if program_key is not None:
+            get_program_costs().observe("lm_prefill_slot", program_key,
+                                        seconds)
         self._m_dispatch.labels(kind="prefill").inc()
         self._m_tokens.inc()
         self._m_busy.inc(seconds)
@@ -214,15 +225,21 @@ class ServeMetrics:
         self._emit(**fields)
 
     def record_step(self, bucket, rows: int, max_batch: int,
-                    seconds: float) -> None:
+                    seconds: float,
+                    program_key: str | None = None) -> None:
         """One row-level decode step over a bucket's slab: ``rows`` live
-        slots each emitted one token (``new_tokens`` == ``rows``)."""
+        slots each emitted one token (``new_tokens`` == ``rows``).
+        ``program_key`` joins the step's wall time onto the decode
+        program's cost model, feeding ``marlin_program_roofline_frac``."""
         with self._lock:
             self.steps += 1
             self.new_tokens += rows
             self.busy_s += seconds
             self._step_occupancy_sum += rows / max_batch
             self._step_s.add(seconds)
+        if program_key is not None:
+            get_program_costs().observe("lm_decode_rows", program_key,
+                                        seconds)
         self._m_dispatch.labels(kind="step").inc()
         self._m_tokens.inc(rows)
         self._m_busy.inc(seconds)

@@ -172,7 +172,14 @@ class StageTimes:
 
 @contextlib.contextmanager
 def trace(logdir: str = "/tmp/marlin_tpu_trace"):
-    """Emit a jax.profiler trace viewable in TensorBoard/XProf."""
+    """Emit a jax.profiler trace viewable in TensorBoard/XProf.
+
+    This is the inline, wrap-your-own-code spelling. For a *running*
+    process, the same capture is a triggerable service:
+    :func:`marlin_tpu.obs.perf.capture_profile` (single-flight, rotating
+    size-capped capture dir, ``kind="profile"`` EventLog record), exposed
+    as ``POST /debug/profile?seconds=N`` on the obs HTTP server and as a
+    SIGUSR2 hook — no code change, no restart."""
     jax.profiler.start_trace(logdir)
     try:
         yield logdir

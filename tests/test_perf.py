@@ -1,0 +1,586 @@
+"""Performance-introspection suite (obs/perf.py + its integrations).
+
+The load-bearing tests: roofline math against fake ``cost_analysis()``
+dicts (zero-time and zero-flop are *results*, not crashes), the flight
+recorder dumping a parseable black box when the existing
+``serve.decode_step`` fault point fires, the single-flight guarantee of
+``/debug/profile`` (second concurrent request gets 409 — two overlapping
+jax.profiler traces corrupt each other), the upgraded ``/healthz``
+readiness states, and the bench regression gate firing on the checked-in
+seeded fixture while passing the clean pair.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from marlin_tpu.config import config_context
+from marlin_tpu.obs import perf
+from marlin_tpu.obs.exposition import (MetricsServer, health_payload,
+                                       register_health_provider,
+                                       unregister_health_provider)
+from marlin_tpu.obs.metrics import MetricsRegistry
+from marlin_tpu.obs.perf import (FlightRecorder, ProgramCosts, program_key,
+                                 roofline)
+from marlin_tpu.obs.report import analyze, load_events
+from marlin_tpu.utils import faults
+from marlin_tpu.utils.faults import RaiseFault
+from marlin_tpu.utils.tracing import EventLog, set_default_event_log
+
+HEADS = 2
+
+
+@pytest.fixture()
+def default_log(tmp_path):
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    prev = set_default_event_log(log)
+    yield log
+    set_default_event_log(prev)
+    log.close()
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    from marlin_tpu.models import TransformerLM
+
+    return TransformerLM(vocab=32, d_model=16, heads=HEADS, layers=2,
+                         seed=9).init_params()
+
+
+# ------------------------------------------------------------- roofline math
+
+
+def test_roofline_compute_bound():
+    # intensity 100 F/B, bw bound 1e9*100 = 1e11 > peak 1e10 -> compute bound
+    r = roofline(flops=1e8, bytes_accessed=1e6, seconds=0.1,
+                 peak_flops=1e10, peak_bw=1e9)
+    assert r["achieved_flops_per_s"] == pytest.approx(1e9)
+    assert r["attainable_flops_per_s"] == pytest.approx(1e10)
+    assert r["roofline_frac"] == pytest.approx(0.1)
+
+
+def test_roofline_bandwidth_bound():
+    # intensity 0.1 F/B: attainable = bw * intensity = 1e8 << peak flops
+    r = roofline(flops=1e6, bytes_accessed=1e7, seconds=0.01,
+                 peak_flops=1e12, peak_bw=1e9)
+    assert r["attainable_flops_per_s"] == pytest.approx(1e8)
+    assert r["roofline_frac"] == pytest.approx(1.0)  # achieved == attainable
+
+
+def test_roofline_zero_time_and_zero_flops():
+    # zero/None time: no measurement, never a ZeroDivisionError
+    for sec in (0, 0.0, None):
+        r = roofline(1e9, 1e6, sec, 1e12, 1e9)
+        assert r["achieved_flops_per_s"] is None
+        assert r["roofline_frac"] is None
+    # zero-FLOP program (pure transfer): bandwidth roofline
+    r = roofline(0, 1e6, 0.001, 1e12, 1e9)
+    assert r["achieved_flops_per_s"] is None
+    assert r["achieved_bytes_per_s"] == pytest.approx(1e9)
+    assert r["roofline_frac"] == pytest.approx(1.0)
+    # zero flops AND zero bytes: nothing to say
+    r = roofline(0, 0, 0.001, 1e12, 1e9)
+    assert r["roofline_frac"] is None
+    # flops but no peaks known: fraction stays unreported
+    r = roofline(1e9, 1e6, 0.1, None, None)
+    assert r["achieved_flops_per_s"] == pytest.approx(1e10)
+    assert r["roofline_frac"] is None
+
+
+def test_roofline_not_clamped():
+    # achieved > attainable surfaces as frac > 1 (a wrong peak table is
+    # worth seeing, not hiding)
+    r = roofline(1e12, 1e6, 0.1, 1e12, None)
+    assert r["roofline_frac"] == pytest.approx(10.0)
+
+
+def test_peak_rates_config_override():
+    with config_context(obs_peak_flops=5e12, obs_peak_bw=7e11):
+        assert perf.peak_rates() == (5e12, 7e11)
+    pf, bw = perf.peak_rates()  # CPU detection: nominal but present
+    assert pf and pf > 0 and bw and bw > 0
+
+
+# ------------------------------------------------------------- program costs
+
+
+def test_program_costs_fake_cost_dict(default_log):
+    costs = ProgramCosts()
+    key = program_key(bucket="8x4", rows=4, dtype="float32")
+    assert key == "bucket=8x4 rows=4 dtype=float32"
+    snap = costs.capture("prog", key,
+                         cost={"flops": 1000.0, "bytes accessed": 500.0},
+                         log=default_log)
+    assert snap["flops"] == 1000.0 and snap["bytes"] == 500.0
+    assert costs.has("prog", key)
+    costs.observe("prog", key, seconds=0.002, calls=4)
+    with config_context(obs_peak_flops=1e7, obs_peak_bw=1e9):
+        (row,) = costs.rows()
+    assert row["calls"] == 4 and row["seconds_per_call"] == 0.0005
+    assert row["achieved_flops_per_s"] == pytest.approx(2e6)
+    assert row["roofline_frac"] == pytest.approx(0.2)
+    # cost record landed exactly once (second capture is a no-op event-wise)
+    costs.capture("prog", key, cost={"flops": 1000.0})
+    recs = [r for r in default_log.read() if r["kind"] == "program"]
+    assert [r["ev"] for r in recs] == ["cost"]
+
+
+def test_program_costs_zero_flop_and_unmeasured():
+    costs = ProgramCosts()
+    costs.capture("xfer", "k", cost={"flops": 0.0, "bytes accessed": 1e6})
+    costs.observe("xfer", "k", seconds=0.001)
+    costs.capture("never_timed", "k", cost={"flops": 5.0})
+    with config_context(obs_peak_flops=1e12, obs_peak_bw=1e9):
+        rows = {r["program"]: r for r in costs.rows()}
+    assert rows["xfer"]["roofline_frac"] == pytest.approx(1.0)  # bw roofline
+    assert rows["never_timed"]["roofline_frac"] is None
+    assert rows["never_timed"]["calls"] == 0
+
+
+def test_program_costs_capture_real_lowered_and_render():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a, b: a @ b)
+    a, b = jnp.ones((64, 32)), jnp.ones((32, 16))
+    reg = MetricsRegistry()
+    costs = ProgramCosts()
+    key = program_key(m=64, k=32, n=16)
+    snap = costs.capture("mm", key, lowered=f.trace(a, b).lower())
+    assert snap is not None and snap["flops"] == pytest.approx(2 * 64 * 32 * 16)
+    costs.observe("mm", key, 0.001)
+    reg.add_collector(lambda: costs.collect(reg))
+    text = reg.render()
+    assert 'marlin_program_flops{program="mm",key="m=64 k=32 n=16"}' in text
+    assert "marlin_program_achieved_flops_per_s" in text
+    assert "marlin_program_roofline_frac" in text
+
+
+def test_program_costs_capture_never_raises():
+    class Boom:
+        def cost_analysis(self):
+            raise RuntimeError("no analysis on this backend")
+
+    costs = ProgramCosts()
+    assert costs.capture("p", "k", lowered=Boom()) is None
+    assert not costs.has("p", "k")
+    # ...but the ATTEMPT is recorded: hot-path capture sites gate on
+    # tried(), so a backend without cost_analysis() pays the trace exactly
+    # once, never once per dispatch
+    assert costs.tried("p", "k")
+    assert not costs.tried("p", "other")
+
+
+def test_capture_sites_do_not_retry_failed_traces(lm_params):
+    """A bucket whose trace fails must not be re-traced on every dispatch:
+    capture_bucket_costs marks the attempt even when the lowering path
+    raises (simulated via params the slab derivation chokes on)."""
+    from marlin_tpu.serving.batcher import (bucket_program_key,
+                                            capture_bucket_costs)
+
+    bad_params = {"emb": np.zeros((4, 4), np.float32)}  # no l0: trace dies
+    capture_bucket_costs(bad_params, HEADS, (8, 4), 4, rowlevel=True)
+    key = bucket_program_key(bad_params, (8, 4), 4)
+    costs = perf.get_program_costs()
+    assert costs.tried("lm_decode_rows", key)
+    assert not costs.has("lm_decode_rows", key)
+
+
+def test_program_emit_and_report_table(default_log):
+    costs = ProgramCosts()
+    key = program_key(bucket="8x4")
+    costs.capture("lm_decode_rows", key,
+                  cost={"flops": 4000.0, "bytes accessed": 1000.0},
+                  log=default_log)
+    costs.observe("lm_decode_rows", key, seconds=0.004, calls=8)
+    with config_context(obs_peak_flops=1e7, obs_peak_bw=1e9):
+        assert costs.emit(log=default_log) == 1
+    out = analyze(default_log.read())
+    assert "== program utilization ==" in out
+    assert "lm_decode_rows" in out
+    # achieved = 4000 / 0.0005 = 8 MFLOP/s = 0.01 GFLOP/s; frac = 0.8
+    assert "80.00%" in out
+
+
+# ---------------------------------------------------------- serving roofline
+
+
+def test_warmup_captures_and_steps_join(lm_params, default_log):
+    """The tentpole integration: warmup captures the bucket cost models,
+    live decode steps join their wall times, and the global registry renders
+    marlin_program_roofline_frac for the active bucket."""
+    from marlin_tpu import obs
+    from marlin_tpu.serving import Request, ServeEngine
+    from marlin_tpu.serving.batcher import bucket_program_key
+
+    with obs.MetricsServer(port=0) as srv:
+        with ServeEngine(lm_params, HEADS, buckets=((8, 4),), max_batch=4,
+                         max_wait_ms=0.0, queue_depth=32) as eng:
+            eng.warmup()
+            key = bucket_program_key(lm_params, (8, 4), 4)
+            assert perf.get_program_costs().has("lm_decode_rows", key)
+            assert perf.get_program_costs().has("lm_prefill_slot", key)
+            hs = [eng.submit(Request(prompt=[1, 2, 3], steps=3))
+                  for _ in range(4)]
+            eng.drain()
+            assert all(h.result(timeout=30).ok for h in hs)
+            text = urllib.request.urlopen(srv.url, timeout=10).read().decode()
+    rows = {(r["program"], r["key"]): r
+            for r in perf.get_program_costs().rows()}
+    row = rows[("lm_decode_rows", key)]
+    assert row["calls"] >= 1 and row["flops"] > 0
+    assert row["roofline_frac"] is not None  # CPU nominal peaks exist
+    assert "marlin_program_roofline_frac{" in text
+    # engine close emitted util snapshots: the analyzer's table works from
+    # the JSONL alone
+    out = analyze(default_log.read())
+    assert "== program utilization ==" in out and "lm_decode_rows" in out
+
+
+# ------------------------------------------------------------ flight recorder
+
+
+def test_flight_ring_bounded_and_ordered():
+    fr = FlightRecorder(maxlen=8, name="t")
+    for i in range(20):
+        fr.record("step", i=i)
+    recs = fr.records()
+    assert len(recs) == len(fr) == 8
+    assert [r["i"] for r in recs] == list(range(12, 20))
+    assert all(r["kind"] == "flight" and r["src"] == "t" for r in recs)
+
+
+def test_flight_ring_concurrency_stress():
+    """Writers and snapshot readers race freely: no exception, no torn
+    record, the ring stays bounded."""
+    fr = FlightRecorder(maxlen=64, name="stress")
+    stop = threading.Event()
+    errors = []
+
+    def write(tid):
+        try:
+            for i in range(500):
+                fr.record("step", tid=tid, i=i)
+        except Exception as e:  # pragma: no cover - the failure under test
+            errors.append(e)
+
+    def read():
+        try:
+            while not stop.is_set():
+                for r in fr.records():
+                    assert r["kind"] == "flight"
+                _ = len(fr)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    writers = [threading.Thread(target=write, args=(t,)) for t in range(4)]
+    readers = [threading.Thread(target=read) for _ in range(2)]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not errors
+    assert len(fr) == 64
+
+
+def test_flight_dump_and_prune(tmp_path, default_log):
+    with config_context(obs_profile_dir=str(tmp_path)):
+        fr = FlightRecorder(maxlen=4, name="dumpme")
+        assert fr.dump(reason="empty") is None  # empty ring: no file
+        fr.record("step", i=1, seconds=0.01)
+        path = fr.dump(reason="test")
+    assert path and os.path.exists(path)
+    recs, skipped = load_events(path)
+    assert skipped == 0 and recs[0]["ev"] == "step"
+    assert recs[0]["reason"] == "test"
+    dump_ev = [r for r in default_log.read() if r["kind"] == "flight"]
+    assert dump_ev and dump_ev[0]["path"] == path
+
+
+def test_flight_dump_on_decode_step_fault(lm_params, tmp_path, default_log):
+    """The black-box acceptance path: an injected serve.decode_step fault
+    fails the step's rows AND lands a flight-recorder JSONL dump whose
+    records obs.report parses."""
+    from marlin_tpu.serving import Request, ServeEngine
+
+    with config_context(obs_profile_dir=str(tmp_path)):
+        eng = ServeEngine(lm_params, HEADS, buckets=((8, 4),), max_batch=4,
+                          max_wait_ms=0.0, queue_depth=32, start=False)
+        try:
+            hs = [eng.submit(Request(prompt=[1, 2], steps=3))
+                  for _ in range(2)]
+            with faults.injected("serve.decode_step", RaiseFault(times=1)):
+                eng.start()
+                for h in hs:
+                    r = h.result(timeout=60)
+                    assert r.status == "error"
+            ok = eng.submit(Request(prompt=[3], steps=2))
+            assert ok.result(timeout=60).ok  # engine keeps serving
+        finally:
+            eng.close()
+    dumps = [r for r in default_log.read()
+             if r["kind"] == "flight" and r.get("ev") == "dump"
+             and r.get("reason") == "decode-step-failed"]
+    assert dumps, "no flight dump landed for the injected decode fault"
+    path = dumps[0]["path"]
+    recs, skipped = load_events(path)
+    assert skipped == 0 and recs
+    # the ring shows the fault itself plus the iterations leading up to it
+    evs = {r["ev"] for r in recs}
+    assert "decode_fault" in evs and "prefill" in evs
+    assert all(r["kind"] == "flight" for r in recs)
+    analyze(recs)  # parseable by the analyzer, end to end
+
+
+# ------------------------------------------------------------------ /healthz
+
+
+def test_health_payload_states():
+    register_health_provider("t-accepting", lambda: {"state": "accepting"})
+    try:
+        code, body = health_payload()
+        assert code == 200 and body["status"] == "ok"
+        register_health_provider("t-draining", lambda: {"state": "draining"})
+        code, body = health_payload()
+        assert code == 503 and body["status"] == "unavailable"
+        unregister_health_provider("t-draining")
+
+        def broken():
+            raise RuntimeError("probe died")
+
+        register_health_provider("t-broken", broken)
+        code, body = health_payload()
+        assert code == 503
+        assert any(e["state"] == "error" for e in body["engines"])
+    finally:
+        for n in ("t-accepting", "t-draining", "t-broken"):
+            unregister_health_provider(n)
+
+
+def test_healthz_reports_live_engine_and_503_when_draining(lm_params):
+    from marlin_tpu.serving import ServeEngine
+
+    with MetricsServer(port=0) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        eng = ServeEngine(lm_params, HEADS, buckets=((8, 4),), max_batch=4,
+                          max_wait_ms=0.0, queue_depth=8)
+        try:
+            body = urllib.request.urlopen(base + "/healthz",
+                                          timeout=10).read().decode()
+            payload = json.loads(body)
+            mine = [e for e in payload["engines"]
+                    if e["name"] == eng._name]
+            assert mine and mine[0]["state"] == "accepting"
+            assert "live_slots" in mine[0] and "queue_depth" in mine[0]
+            # the 503 leg, deterministic: flip the state the provider reads
+            eng._state = "draining"
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + "/healthz", timeout=10)
+            assert exc.value.code == 503
+            eng._state = "running"
+        finally:
+            eng.close()
+        # terminal close unregistered the provider: healthz recovers
+        code, payload = health_payload()
+        assert all(e.get("name") != eng._name for e in payload["engines"])
+
+
+def test_healthz_503_after_worker_death(lm_params):
+    """A crashed worker (BaseException — not the absorbed per-step
+    Exception class) must flip the engine out of 'accepting': the probe the
+    readiness upgrade exists for is exactly 'stop routing to an engine
+    that cannot make progress'."""
+    from marlin_tpu.serving import Request, ServeEngine
+
+    eng = ServeEngine(lm_params, HEADS, buckets=((8, 4),), max_batch=4,
+                      max_wait_ms=0.0, queue_depth=16, start=False)
+    try:
+        hs = [eng.submit(Request(prompt=[1, 2], steps=3)) for _ in range(2)]
+        with faults.injected("serve.decode_step",
+                             RaiseFault(exc=KeyboardInterrupt, times=1)):
+            eng.start()
+            for h in hs:
+                assert h.result(timeout=60).status == "error"
+        eng._thread.join(timeout=30)
+        assert not eng._thread.is_alive()
+        code, body = health_payload()
+        mine = [e for e in body["engines"] if e.get("name") == eng._name]
+        assert code == 503 and mine and mine[0]["state"] == "closed"
+    finally:
+        eng.close()
+
+
+def test_engine_heartbeat_ages(lm_params):
+    from marlin_tpu.serving import Request, ServeEngine
+
+    with ServeEngine(lm_params, HEADS, buckets=((8, 4),), max_batch=4,
+                     max_wait_ms=0.0, queue_depth=8) as eng:
+        h = eng.submit(Request(prompt=[1, 2], steps=2))
+        assert h.result(timeout=30).ok
+        info = eng._health_info()
+        assert info["worker_started"]
+        assert info["heartbeat_age_s"] is not None
+        assert info["heartbeat_age_s"] >= 0
+
+
+# ------------------------------------------------------------- debug HTTP
+
+
+def test_debug_profile_single_flight_409(tmp_path):
+    """Second concurrent /debug/profile gets 409 (single-flight), and the
+    capture lands an artifact + kind="profile" record once free."""
+    with config_context(obs_profile_dir=str(tmp_path)):
+        with MetricsServer(port=0) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            assert perf._profile_lock.acquire(blocking=False)
+            try:  # a capture "in flight": the next request must 409
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(base + "/debug/profile?seconds=0",
+                                           data=b"", timeout=10)
+                assert exc.value.code == 409
+            finally:
+                perf._profile_lock.release()
+            body = urllib.request.urlopen(
+                base + "/debug/profile?seconds=0.05", data=b"",
+                timeout=60).read().decode()
+            out = json.loads(body)
+            assert os.path.isdir(out["path"])
+            assert out["path"].startswith(str(tmp_path))
+            for bad in ("nope", "nan", "inf"):  # nan slides past min/max
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(
+                        base + f"/debug/profile?seconds={bad}",
+                        data=b"", timeout=10)
+                assert exc.value.code == 400, bad
+
+
+def test_capture_profile_programmatic(tmp_path, default_log):
+    path = perf.capture_profile(seconds=0.0, logdir=str(tmp_path))
+    assert os.path.isdir(path)
+    recs = [r for r in default_log.read() if r["kind"] == "profile"]
+    assert recs and recs[0]["path"] == path
+
+
+def test_debug_flight_endpoint(lm_params):
+    from marlin_tpu.serving import Request, ServeEngine
+
+    with MetricsServer(port=0) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        with ServeEngine(lm_params, HEADS, buckets=((8, 4),), max_batch=4,
+                         max_wait_ms=0.0, queue_depth=8) as eng:
+            h = eng.submit(Request(prompt=[1, 2], steps=3))
+            assert h.result(timeout=30).ok
+            body = urllib.request.urlopen(base + "/debug/flight",
+                                          timeout=10).read().decode()
+        recs = [json.loads(line) for line in body.splitlines() if line]
+        mine = [r for r in recs if r.get("src") == eng._name]
+        assert mine and any(r["ev"] in ("step", "prefill") for r in mine)
+
+
+# --------------------------------------------------------------- bench gate
+
+
+def _gate(base, new):
+    from tools.bench_compare import main
+
+    fixtures = os.path.join(os.path.dirname(__file__), "..", "tools",
+                            "fixtures")
+    return main([os.path.join(fixtures, base), os.path.join(fixtures, new)])
+
+
+def test_bench_gate_clean_pair_passes(capsys):
+    assert _gate("bench_gate_base.json", "bench_gate_clean.json") == 0
+    assert "gate passed" in capsys.readouterr().out
+
+
+def test_bench_gate_fires_on_seeded_regression(capsys):
+    assert _gate("bench_gate_base.json", "bench_gate_regressed.json") == 1
+    out = capsys.readouterr().out
+    assert "GATE FAILED" in out
+    assert "2_dense_4000" in out and "REGRESSION" in out
+    assert "ttft p50 672->1400 ms" in out  # the TTFT leg fired too
+    assert "5_FAILED" in out  # new crash counts as a regression
+
+
+def test_bench_gate_frac_is_informational():
+    from tools.bench_compare import compare
+
+    base = {"serve_decode_roofline": {"config": "serve_decode_roofline",
+                                      "value": 0.30, "unit": "frac"}}
+    new = {"serve_decode_roofline": {"config": "serve_decode_roofline",
+                                     "value": 0.05, "unit": "frac"}}
+    rows, regressed = compare(base, new)
+    assert not regressed  # utilization explains regressions, never IS one
+    assert rows[0][5] == "info"
+
+
+def test_bench_gate_zero_baseline_still_fires():
+    from tools.bench_compare import compare
+
+    base = {"acc": {"config": "acc", "value": 0.0, "unit": "rel err"}}
+    worse = {"acc": {"config": "acc", "value": 0.5, "unit": "rel err"}}
+    same = {"acc": {"config": "acc", "value": 0.0, "unit": "rel err"}}
+    _, regressed = compare(base, worse)
+    assert regressed  # any rise off an exact-zero lower-better baseline
+    _, regressed = compare(base, same)
+    assert not regressed
+
+
+def test_bench_gate_threshold_override(tmp_path, capsys):
+    from tools.bench_compare import compare, load
+
+    fixtures = os.path.join(os.path.dirname(__file__), "..", "tools",
+                            "fixtures")
+    base = load(os.path.join(fixtures, "bench_gate_base.json"))
+    new = load(os.path.join(fixtures, "bench_gate_clean.json"))
+    # tighten one config to 1%: the clean pair's -2.2% wobble now trips
+    rows, regressed = compare(base, new, tolerance=0.25,
+                              thresholds={"2_dense_4000": 0.01})
+    assert regressed
+    assert [r for r in rows if r[0] == "2_dense_4000"][0][5] == "REGRESSION"
+
+
+# ----------------------------------------------------------- streamed / tune
+
+
+def test_streamed_gramian_observes_costs(default_log):
+    from marlin_tpu.parallel.streaming import streamed_gramian
+
+    chunks = [np.ones((16, 8), np.float32)] * 3
+    streamed_gramian(iter(chunks), prefetch=False)
+    rows = [r for r in perf.get_program_costs().rows()
+            if r["program"] == "streamed_gramian"
+            and "chunk=16x8" in r["key"]]
+    assert rows and rows[0]["calls"] >= 3 and rows[0]["flops"] > 0
+    utils = [r for r in default_log.read() if r["kind"] == "program"
+             and r.get("ev") == "util"
+             and r.get("program") == "streamed_gramian"]
+    assert utils
+
+
+def test_autotune_lands_candidate_timings():
+    import marlin_tpu as mt
+    from marlin_tpu.parallel.autotune import tune_multiply
+
+    mesh = mt.create_mesh()
+    a = mt.DenseVecMatrix.random(0, 64, 64, mesh=mesh)
+    b = mt.DenseVecMatrix.random(1, 64, 64, mesh=mesh)
+    # gspmd + broadcast (rmm's jax.shard_map path is broken at the seed on
+    # this jax version — tracked in tier-1's pre-existing failures)
+    results = tune_multiply(a, b, strategies=["gspmd", "broadcast"], reps=1)
+    assert results
+    rows = [r for r in perf.get_program_costs().rows()
+            if r["program"] == "multiply" and "shape=64x64x64" in r["key"]]
+    strategies = {r["key"].split()[0].split("=")[1] for r in rows}
+    assert {"gspmd", "broadcast"} <= strategies
+    assert all(r["calls"] >= 1 and r["flops"] == pytest.approx(2 * 64**3)
+               for r in rows if r["key"].split()[0].split("=")[1]
+               in ("gspmd", "broadcast"))

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Bench regression gate: diff two BENCH_ALL.json-shaped files.
+
+``python tools/bench_compare.py BASE NEW`` compares per-config measurements
+(the ``[{config, value, unit, detail, ...}]`` list bench_all.py writes),
+prints a markdown summary, and exits nonzero when anything regressed beyond
+tolerance — the CI tripwire that keeps the BENCH numbers from silently
+sliding between rounds.
+
+What counts as a regression:
+
+- a **throughput/accuracy config** (GFLOP/s, tok/s, ktok/s, steps/s, ...)
+  whose NEW value fell below ``BASE * (1 - tolerance)``;
+- a **latency config** (ms, s, ms/iter, s/sweep, rel err) whose NEW value
+  rose above ``BASE * (1 + tolerance)``;
+- a serve config whose ``ttft p50 N ms`` detail (bench_all embeds it in the
+  record detail) rose beyond the same bound — TTFT is the serving headline
+  and must not hide inside an unchanged tok/s;
+- a ``*_FAILED`` error record in NEW with no counterpart in BASE (a config
+  that used to run and now crashes is the worst regression of all);
+- a config present in BASE but missing from NEW is *reported* (dropped)
+  but does not fail the gate — partial sweeps are routine.
+
+``roofline_frac`` (bench_all's utilization ride-along) is shown when either
+side carries it, informational only: utilization explains a throughput
+regression, it does not define one.
+
+Per-config overrides: ``--threshold serve_load64=0.1`` (repeatable) tightens
+or loosens one config without moving the global ``--tolerance``.
+
+``make bench-gate`` (tools/Makefile) runs this over the checked-in fixture
+pair; pointing NEW at ``bench_gate_regressed.json`` proves the gate fires.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+#: units where smaller is better; anything else (GFLOP/s, tok/s, steps/s,
+#: ktok/s, families, ...) is larger-is-better
+LOWER_BETTER = {"ms", "s", "ms/iter", "s/sweep", "rel err"}
+
+#: units shown but never gated: roofline fractions are utilization
+#: *explanations* (and nominal on CPU — docs/performance.md), they swing
+#: with load mix far more than any sane tolerance and must not fail CI on
+#: their own — the throughput/latency configs they explain are the gate
+INFORMATIONAL = {"frac"}
+
+_TTFT_RE = re.compile(r"ttft p50 (\d+(?:\.\d+)?) ms")
+
+
+def load(path: str) -> dict[str, dict]:
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, list):
+        raise ValueError(f"{path}: expected a BENCH_ALL.json-shaped list")
+    return {r["config"]: r for r in data if isinstance(r, dict)
+            and "config" in r}
+
+
+def _ttft_ms(rec: dict) -> float | None:
+    m = _TTFT_RE.search(str(rec.get("detail", "")))
+    return float(m.group(1)) if m else None
+
+
+def _frac(rec: dict):
+    v = rec.get("roofline_frac")
+    return f"{v:.3f}" if isinstance(v, (int, float)) else ""
+
+
+def compare(base: dict[str, dict], new: dict[str, dict],
+            tolerance: float = 0.25,
+            thresholds: dict[str, float] | None = None) -> tuple[list, bool]:
+    """Rows ``(config, base_str, new_str, delta_str, unit, status, note)``
+    plus the overall regressed flag."""
+    thresholds = thresholds or {}
+    rows, regressed = [], False
+    for name in sorted(set(base) | set(new)):
+        b, n = base.get(name), new.get(name)
+        if n is None:
+            rows.append((name, b["value"], "-", "", b["unit"], "dropped",
+                         _frac(b)))
+            continue
+        if b is None:
+            status = "ok"
+            if n.get("unit") == "error" or name.endswith("_FAILED"):
+                status, regressed = "REGRESSION", True
+            rows.append((name, "-", n["value"], "", n.get("unit", ""),
+                         status if status != "ok" else "new", _frac(n)))
+            continue
+        unit = n.get("unit", b.get("unit", ""))
+        tol = thresholds.get(name, tolerance)
+        bv, nv = float(b["value"]), float(n["value"])
+        if unit == "error":
+            # failed on both sides: broken, but not newly broken
+            rows.append((name, bv, nv, "", unit, "still-failing", ""))
+            continue
+        if unit in INFORMATIONAL:
+            delta = (nv - bv) / abs(bv) if bv else 0.0
+            rows.append((name, bv, nv, f"{delta * 100:+.1f}%", unit,
+                         "info", _frac(n)))
+            continue
+        lower_better = unit in LOWER_BETTER
+        if bv == 0:
+            # no relative delta off a zero baseline — but a lower-is-better
+            # config rising off exact zero (e.g. rel err 0 -> 0.5) is a
+            # regression of arbitrary relative size, so it always fires
+            delta_str = ""
+            bad = lower_better and nv > 0
+        else:
+            delta = (nv - bv) / abs(bv)
+            delta_str = f"{delta * 100:+.1f}%"
+            bad = (delta > tol) if lower_better else (delta < -tol)
+        status = "REGRESSION" if bad else "ok"
+        note = _frac(n)
+        # the serving TTFT leg: parsed from the detail string both sides
+        bt, nt = _ttft_ms(b), _ttft_ms(n)
+        if bt and nt and nt > bt * (1 + tol):
+            bad = True
+            status = "REGRESSION"
+            note = (note + " " if note else "") + \
+                f"ttft p50 {bt:.0f}->{nt:.0f} ms"
+        if bad:
+            regressed = True
+        rows.append((name, bv, nv, delta_str, unit, status, note))
+    return rows, regressed
+
+
+def markdown(rows: list, base_path: str, new_path: str) -> str:
+    out = [f"# Bench gate: `{new_path}` vs `{base_path}`", "",
+           "| Config | Base | New | Δ | Unit | Status | Note |",
+           "|---|---|---|---|---|---|---|"]
+    for name, bv, nv, delta, unit, status, note in rows:
+        flag = "**REGRESSION**" if status == "REGRESSION" else status
+        out.append(f"| {name} | {bv} | {nv} | {delta} | {unit} | {flag} "
+                   f"| {note} |")
+    bad = sum(1 for r in rows if r[5] == "REGRESSION")
+    out.append("")
+    out.append(f"{'**GATE FAILED**' if bad else 'gate passed'}: "
+               f"{bad} regression(s) over {len(rows)} config(s)")
+    return "\n".join(out) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two BENCH_ALL.json files; exit 1 on regression")
+    ap.add_argument("base", help="baseline BENCH_ALL.json-shaped file")
+    ap.add_argument("new", help="candidate file to gate")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="allowed fractional slide per config "
+                         "(default 0.25)")
+    ap.add_argument("--threshold", action="append", default=[],
+                    metavar="CONFIG=TOL",
+                    help="per-config tolerance override (repeatable)")
+    ap.add_argument("--out", default=None,
+                    help="also write the markdown summary here")
+    args = ap.parse_args(argv)
+    thresholds = {}
+    for spec in args.threshold:
+        name, _, tol = spec.partition("=")
+        if not tol:
+            ap.error(f"--threshold wants CONFIG=TOL, got {spec!r}")
+        thresholds[name] = float(tol)
+    try:
+        base, new = load(args.base), load(args.new)
+    except (OSError, ValueError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+    rows, regressed = compare(base, new, args.tolerance, thresholds)
+    md = markdown(rows, args.base, args.new)
+    sys.stdout.write(md)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(md)
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

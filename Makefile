@@ -1,0 +1,19 @@
+# Repo-level entry points. `make check` is the default: the serving gate
+# (tier-1 serving + resilience tests, then tools/bench_compare.py over the
+# BENCH_ALL.json serve_* records), the bench-gate selftest, and the
+# obs-report smoke — see tools/Makefile for the individual targets and
+# their knobs (SERVE_BASE/SERVE_NEW, BASE/NEW).
+
+.DEFAULT_GOAL := check
+
+check:
+	$(MAKE) -C tools check
+
+serve-gate:
+	$(MAKE) -C tools serve-gate
+
+tier1:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+.PHONY: check serve-gate tier1

@@ -630,7 +630,12 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     MARLIN_BENCH_SERVE_WARMUP=0 skips the per-bucket pre-compile (the
     first-request-pays-the-compile A/B),
     MARLIN_BENCH_SERVE_ROWLEVEL=0 is the gang-scheduler control for the
-    row-level A/B (docs/performance.md records the pair). The model
+    row-level A/B (docs/performance.md records the pair),
+    MARLIN_BENCH_SERVE_ROUTER=N (0 = off, the default) serves each rate
+    through a Router over N supervised engine replicas instead of one bare
+    engine — the resilience-layer A/B (records get a `_router` suffix;
+    the acceptance bar is routed tok/s within 5% of the single-engine
+    baseline at the top rate). The model
     (d_model=128, heads=8, layers=4) is sized so decode COMPUTE is
     non-trivial relative to dispatch — the serving regime; at toy sizes the
     sweep measures Python/dispatch overhead, where a fused gang program
@@ -650,7 +655,7 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     from marlin_tpu import obs
     from marlin_tpu.models import TransformerLM
     from marlin_tpu.obs import collectors
-    from marlin_tpu.serving import Request, ServeEngine, percentile
+    from marlin_tpu.serving import Request, Router, ServeEngine, percentile
     from marlin_tpu.utils.tracing import EventLog, set_default_event_log
 
     rates = [float(r) for r in os.environ.get(
@@ -659,6 +664,8 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     max_batch = int(os.environ.get("MARLIN_BENCH_SERVE_BATCH", 8))
     warmup = os.environ.get("MARLIN_BENCH_SERVE_WARMUP", "1") != "0"
     rowlevel = os.environ.get("MARLIN_BENCH_SERVE_ROWLEVEL", "1") != "0"
+    router_n = int(os.environ.get("MARLIN_BENCH_SERVE_ROUTER", "0"))
+    suffix = ("" if rowlevel else "_gang") + ("_router" if router_n else "")
     steps_lo, steps_hi = (int(v) for v in os.environ.get(
         "MARLIN_BENCH_SERVE_STEPS", "4,32").split(","))
     buckets = ((64, 32), (256, 32))
@@ -668,11 +675,10 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     rng = np.random.default_rng(0)
 
     events_path = os.environ.get("MARLIN_BENCH_SERVE_EVENTS") or os.path.join(
-        tempfile.gettempdir(),
-        f"marlin_serve_events{'' if rowlevel else '_gang'}.jsonl")
-    for suffix in ("", ".1", ".2"):  # fresh stream per sweep
-        if os.path.exists(events_path + suffix):
-            os.remove(events_path + suffix)
+        tempfile.gettempdir(), f"marlin_serve_events{suffix}.jsonl")
+    for rot in ("", ".1", ".2"):  # fresh stream per sweep
+        if os.path.exists(events_path + rot):
+            os.remove(events_path + rot)
     elog = EventLog(events_path)
     prev_log = set_default_event_log(elog)
     srv = obs.MetricsServer(port=int(os.environ.get("MARLIN_BENCH_OBS_PORT",
@@ -680,13 +686,22 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     obs_port = srv.start()  # installs compile + device-memory collectors
     scrape = ""
 
+    def make_engine():
+        return ServeEngine(params, heads, buckets=buckets,
+                           max_batch=max_batch, max_wait_ms=5.0,
+                           queue_depth=4 * n_req, rowlevel=rowlevel)
+
     def run_rate(rate):
         nonlocal scrape
-        eng = ServeEngine(params, heads, buckets=buckets,
-                          max_batch=max_batch, max_wait_ms=5.0,
-                          queue_depth=4 * n_req, rowlevel=rowlevel)
+        if router_n:
+            # the resilience A/B: supervised replicas behind the router,
+            # same total offered load (admission capacity scales with N —
+            # per-replica queues still bound overload)
+            eng = Router(make_engine, replicas=router_n, warmup=warmup)
+        else:
+            eng = make_engine()
         try:
-            if warmup:
+            if warmup and not router_n:
                 eng.warmup()
             gaps = rng.exponential(1.0 / rate, n_req)
             handles, t_start = [], time.perf_counter()
@@ -728,7 +743,7 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
         lat = [r.metrics["total_s"] for r in ok]
         ttft = [r.metrics["ttft_s"] for r in ok
                 if r.metrics.get("ttft_s") is not None]
-        snap = eng.metrics.snapshot()
+        snap = eng.snapshot() if router_n else eng.metrics.snapshot()
         toks = sum(r.tokens.size - len(h.request.prompt)
                    for h, r in zip(handles, results) if r.ok)
         # a fully-shed load point (admission rejecting everything, chaos
@@ -737,14 +752,18 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
             f"{percentile(xs, q) * 1e3:.0f}" if xs else "n/a")
         sched = (f"row-level, {snap['steps']} decode steps"
                  if rowlevel else f"gang, {snap['batches']} batches")
-        # the gang control keeps its own record key so the A/B pair
-        # coexists in BENCH_ALL.json (the merge is keyed by config name)
-        record(f"serve_load{rate:g}" + ("" if rowlevel else "_gang"),
+        if router_n:
+            sched = (f"{router_n}-replica supervised router "
+                     f"({snap['retries']} retries), " + sched)
+        occ = snap.get("occupancy_mean", "n/a")
+        # the gang/router controls keep their own record keys so the A/B
+        # tuple coexists in BENCH_ALL.json (the merge is keyed by config)
+        record(f"serve_load{rate:g}" + suffix,
                toks / span, "tok/s",
                f"{len(ok)}/{n_req} ok at {rate:g} req/s offered; p50 "
                f"{ms(lat, 50)} ms / p99 {ms(lat, 99)} ms latency; ttft p50 "
                f"{ms(ttft, 50)} ms / p99 {ms(ttft, 99)} ms; occupancy "
-               f"{snap['occupancy_mean']}, {sched}, "
+               f"{occ}, {sched}, "
                f"warmup={'on' if warmup else 'off'}")
 
     try:
@@ -770,7 +789,7 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
                    if r["achieved_flops_per_s"] else "n/a")
             peak = (f"peak {r['peak_flops'] / 1e12:.1f} TFLOP/s"
                     if r["peak_flops"] else "the bandwidth roofline")
-            record("serve_decode_roofline" + ("" if rowlevel else "_gang"),
+            record("serve_decode_roofline" + suffix,
                    r["roofline_frac"], "frac",
                    f"{decode_prog}[{r['key']}]: {ach} achieved over "
                    f"{r['calls']} dispatches vs {peak} "
@@ -789,13 +808,17 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
             "marlin_compile_total", "marlin_prefetch_chunks_total",
             "marlin_device_memory_bytes_in_use",
             "marlin_program_roofline_frac")
+    if router_n:
+        # the resilience families ride only when the router/supervisors ran
+        want += ("marlin_serve_retries_total", "marlin_serve_restarts_total",
+                 "marlin_serve_replica_state")
     got = [n for n in want if f"# TYPE {n} " in scrape]
     # same "trace-joined" definition as python -m marlin_tpu.obs.report
     from marlin_tpu.obs.report import trace_join
     joined, total = trace_join(elog.read(include_rotated=True))
     trace_note = (f"{joined}/{total} requests trace-joined"
                   if total else "no serve events recorded")
-    record("serve_obs" + ("" if rowlevel else "_gang"), float(len(got)),
+    record("serve_obs" + suffix, float(len(got)),
            "families",
            f"live /metrics scrape during serve carried {len(got)}/{len(want)}"
            f" series ({', '.join(got)}); {trace_note}; events at "

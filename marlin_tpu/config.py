@@ -110,6 +110,27 @@ class MarlinConfig:
     # gang scheduler (one fused program per bucket runs a whole batch to
     # completion; rows land together). docs/serving.md compares the two.
     serve_rowlevel: bool = True
+    # --- serving resilience (serving/supervisor.py, serving/router.py) ------
+    # Supervisor watchdog: a worker whose heartbeat is older than this many
+    # real seconds while work is pending is declared stuck and recovered
+    # (its generation is superseded; live rows requeue within their attempt
+    # budget). 0 disables the stuck-worker check (crash detection stays on).
+    serve_watchdog_s: float = 30.0
+    # Restart circuit breaker: more than serve_restart_max worker restarts
+    # inside a sliding serve_restart_window_s window opens the breaker — the
+    # engine is failed permanently (queued work retired, no further
+    # restarts) instead of crash-looping against a deterministic bug.
+    serve_restart_max: int = 5
+    serve_restart_window_s: float = 60.0
+    # Exponential-backoff base delay between worker restarts (doubles per
+    # restart in the current window, capped at 16x).
+    serve_restart_backoff_s: float = 0.05
+    # Default relative deadline (seconds from submit) applied to requests
+    # that carry neither deadline nor deadline_s. None = no default (requests
+    # without a deadline never expire).
+    serve_default_deadline_s: float | None = None
+    # Engine replicas a Router builds when none are passed explicitly.
+    serve_replicas: int = 2
     # --- autotune persistence (parallel/autotune.py) -------------------------
     # Where the empirical multiply-strategy winners persist across processes.
     # None = ~/.cache/marlin_tpu/autotune.json; "" disables the disk layer

@@ -138,6 +138,25 @@ def _serving_section(events: list[dict]) -> list[str]:
     submitted = sum(1 for r in serve if r.get("ev") == "enqueue")
     status_str = ", ".join(f"{k} {v}" for k, v in sorted(by_status.items()))
     out.append(f"requests: submitted {submitted}; results: {status_str}")
+    # resilience ride-along (only when the stream carries it, so logs from
+    # pre-retry engines render unchanged): transparent re-queues, worker
+    # restarts, breaker transitions. A retried request's queue/ttft/total
+    # below comes from its RESULT record — i.e. the final, successful
+    # attempt; the failed attempts only widen its queue_s.
+    retries = sum(1 for r in serve if r.get("ev") == "retry")
+    restarts = sum(1 for r in serve if r.get("ev") == "restart")
+    breakers = [r for r in serve if r.get("ev") == "breaker"]
+    retried_ok = sum(1 for r in results
+                     if r.get("status") == "ok" and r.get("attempt", 1) > 1)
+    if retries or restarts or breakers:
+        line = (f"resilience: {retries} attempt(s) re-queued, "
+                f"{restarts} worker restart(s)")
+        if retried_ok:
+            line += (f"; {retried_ok} ok result(s) served by a retry "
+                     f"(latency attributed to the final attempt)")
+        if breakers:
+            line += f"; breaker: {breakers[-1].get('state', '?')}"
+        out.append(line)
     ok = [r for r in results if r.get("status") == "ok"
           and isinstance(r.get("total_s"), (int, float))]
     if ok:

@@ -4,8 +4,11 @@ The ROADMAP north star is "heavy traffic from millions of users"; the
 reference delegated all request scheduling to Spark (SURVEY.md §0). This
 package is the TPU-native replacement front half: admission control
 (request.py), shape bucketing + dynamic batch formation (batcher.py), the
-worker-loop engine with a drain-safe lifecycle (engine.py), and serving
-observability through the EventLog (metrics.py).
+worker-loop engine with a drain-safe lifecycle (engine.py), serving
+observability through the EventLog (metrics.py), supervised worker
+recovery with a restart circuit breaker (supervisor.py), and a
+multi-replica router with failover and drain-safe rolling restarts
+(router.py — docs/robustness.md covers the resilience layer).
 
 Quick start::
 
@@ -28,6 +31,8 @@ from .batcher import (  # noqa: F401
 )
 from .engine import ServeEngine  # noqa: F401
 from .metrics import ServeMetrics, percentile  # noqa: F401
+from .router import Router  # noqa: F401
+from .supervisor import Supervisor  # noqa: F401
 from .request import (  # noqa: F401
     STATUS_ERROR,
     STATUS_EXPIRED,

@@ -91,12 +91,23 @@ class _Entry:
     is the request's span context (obs/trace.py), captured at submit and
     re-activated by the worker thread around every record the request
     produces — that cross-thread handoff is what joins one request's
-    enqueue/prefill/result records into one trace in the JSONL."""
+    enqueue/prefill/result records into one trace in the JSONL.
+
+    ``attempt`` counts executions of this request (1-based); a retry
+    re-queues a FRESH entry via :meth:`retry` — same request, handle,
+    admission cost (the HBM reservation is carried, never re-charged), and
+    original ``enq_t`` (latency is honest: it includes the failed
+    attempts) — and marks this one ``superseded`` so a stale worker
+    generation that still holds it can never retire it. The exactly-once
+    Result is enforced twice over: superseded entries no-op in ``_retire``,
+    and the admission budget is released only by whoever wins the handle's
+    single ``_set``."""
 
     __slots__ = ("request", "handle", "bucket", "cost", "enq_t", "queue_s",
-                 "trace")
+                 "trace", "attempt", "superseded")
 
-    def __init__(self, request, handle, bucket, cost, enq_t, trace=None):
+    def __init__(self, request, handle, bucket, cost, enq_t, trace=None,
+                 attempt=1):
         self.request = request
         self.handle = handle
         self.bucket = bucket
@@ -104,6 +115,17 @@ class _Entry:
         self.enq_t = enq_t
         self.queue_s = None
         self.trace = trace
+        self.attempt = attempt
+        self.superseded = False
+
+    def retry(self) -> "_Entry":
+        """The next-attempt twin (this entry becomes superseded)."""
+        self.superseded = True
+        return _Entry(self.request, self.handle, self.bucket, self.cost,
+                      self.enq_t, trace=self.trace, attempt=self.attempt + 1)
+
+    def attempts_left(self) -> bool:
+        return self.attempt < self.request.max_attempts
 
 
 class ServeEngine:
@@ -165,8 +187,24 @@ class ServeEngine:
         self._started = False
         eid = next(_engine_ids)
         self._name = f"marlin-serve-{eid}"
-        self._thread = threading.Thread(
-            target=self._run, daemon=True, name=self._name)
+        # --- supervised recovery (serving/supervisor.py) -------------------
+        # the worker generation: a recovery bumps it, spawns a fresh thread,
+        # and any stale worker still unwinding exits at its next gen check
+        # without touching shared state (its entries are superseded)
+        self._gen = 0
+        self._pools: dict[tuple, object] = {}   # current worker's slot pools
+        self._inflight: list = []               # current gang batch entries
+        self._claimed: list = []                # claimed-but-unslotted rows
+        self._crash: tuple | None = None        # (exc, undone entries)
+        self._on_crash = None                   # supervisor's prompt-wake cb
+        self._abandoned = None                  # superseded wedged thread:
+        # never joined (breaker opened on a stuck worker — close() must not
+        # block on a thread that may never return from its device call)
+        self._idle = False                      # worker parked in cond.wait
+        # EWMA of per-request service seconds (ok results, engine clock) —
+        # the deadline-admission estimate's only input
+        self._service_ewma = 0.0
+        self._thread = self._make_thread(0)
         # --- performance introspection (obs/perf.py) -----------------------
         # the step-time black box: per-iteration records from the worker
         # loop, dumped on worker faults, on close, and via GET /debug/flight
@@ -196,6 +234,14 @@ class ServeEngine:
             self.start()
 
     # ------------------------------------------------------------- lifecycle
+
+    def _make_thread(self, gen: int) -> threading.Thread:
+        """A worker thread for one generation. Restarted generations keep
+        the ``marlin-serve`` prefix (the conftest leak fixture and the
+        flight recorder key on it) with a ``-r<gen>`` suffix."""
+        name = self._name if gen == 0 else f"{self._name}-r{gen}"
+        return threading.Thread(target=self._run, args=(gen,), daemon=True,
+                                name=name)
 
     def start(self) -> None:
         """Start the worker thread (idempotent; no-op once shutting down)."""
@@ -271,21 +317,83 @@ class ServeEngine:
             pass
         unregister_health_provider(self._name)
 
+    def _join_worker(self) -> None:
+        """Join until no worker generation will run again — a supervisor
+        may swap in a fresh generation mid-join (crash during drain), or be
+        a poll interval away from consuming a crash stash; returning after
+        joining a dead predecessor would declare the engine closed with
+        work still queued. Terminates because recovery is bounded: the
+        supervisor's breaker (or the absence of a supervisor) guarantees a
+        final generation."""
+        if not self._started:
+            return
+        waited = 0.0
+        while True:
+            t = self._thread
+            if t is self._abandoned:
+                return  # a wedged generation the breaker gave up on: it
+                # may never return from its device call, and everything it
+                # held was already retired — joining would hang shutdown
+            t.join()
+            with self._cond:
+                if self._thread is not t:
+                    waited = 0.0
+                    continue  # a recovery swapped in a new generation
+                # stash pending + supervisor attached + a state it still
+                # recovers in (check() skips closing/closed engines, so
+                # waiting there would deadlock close())
+                if (self._on_crash is not None and self._crash is not None
+                        and self._state in ("running", "draining")):
+                    recovery_pending = True  # stashed, not yet respawned
+                else:
+                    return
+            if recovery_pending:
+                if waited >= 5.0:
+                    # an attached supervisor whose monitor never consumed
+                    # the stash (e.g. Supervisor(start=False)): waiting
+                    # forever would hang shutdown — return and let the
+                    # caller's _fail_crash_stash / leftover paths resolve
+                    # everything the dead worker held
+                    return
+                time.sleep(0.005)  # let the supervisor consume the stash
+                waited += 0.005
+
+    def _fail_crash_stash(self, reason: str) -> None:
+        """Retire whatever a crashed, never-recovered worker was holding
+        (drain/close with no supervisor attached, or a breaker-opened
+        engine) — the shutdown path must strand nothing."""
+        with self._cond:
+            crash = self._crash
+            self._crash = None
+        if crash is None:
+            return
+        for e in crash[1]:
+            if not e.handle.done():
+                self._retire(e, Result(e.request.rid, STATUS_ERROR,
+                                       reason=reason))
+
     def drain(self) -> None:
-        """Graceful stop: no new admissions (rejections say "draining"), but
-        everything already accepted — queued and in flight — completes.
-        Partial batches dispatch immediately. Terminal: the worker exits and
-        is joined before this returns."""
+        """Graceful stop: no new admissions (post-drain submits resolve
+        ``shutting_down``), but everything already accepted — queued and in
+        flight — completes. Partial batches dispatch immediately. Terminal:
+        the worker exits and is joined before this returns."""
         self._queue.close("engine draining (no new admissions)")
         self.start()  # a never-started engine still owes queued results
         with self._cond:
             if self._state == "running":
                 self._state = "draining"
             self._cond.notify_all()
-        if self._started:
-            self._thread.join()
+        self._join_worker()
+        self._fail_crash_stash("serving worker died while draining")
         with self._cond:
             self._state = "closed"
+            leftovers = self._former.take_all()
+        for e in leftovers:
+            # only reachable when the last worker generation died with no
+            # supervisor left to respawn one — queued work still resolves
+            self._retire(e, Result(e.request.rid, STATUS_ERROR,
+                                   reason="serving worker lost while "
+                                          "draining"))
         self._finalize_obs()
 
     def close(self) -> None:
@@ -303,8 +411,9 @@ class ServeEngine:
             self._retire(e, Result(
                 e.request.rid, STATUS_SHUTTING_DOWN,
                 reason="engine closed before this request was scheduled"))
-        if self._started:
-            self._thread.join()
+        self._join_worker()
+        self._fail_crash_stash("serving worker died; engine closed before "
+                               "recovery")
         with self._cond:
             self._state = "closed"
         self._finalize_obs()
@@ -340,14 +449,45 @@ class ServeEngine:
             return self._refuse(handle, STATUS_REJECTED, (
                 f"no bucket fits prompt_len={request.prompt.shape[0]} "
                 f"steps={request.steps} (buckets {list(self.buckets)})"))
+        # resolve the relative/default deadline to an absolute engine-clock
+        # one, ONCE — a router failover or worker restart must not hand the
+        # request a fresh budget
+        if request.deadline is None:
+            rel = request.deadline_s
+            if rel is None:
+                rel = get_config().serve_default_deadline_s
+            if rel is not None:
+                request.deadline = now + float(rel)
+                request.deadline_s = None
         if request.deadline is not None and request.deadline <= now:
             return self._refuse(handle, STATUS_EXPIRED, (
                 f"deadline {request.deadline} already passed at submission "
                 f"(now {now})"))
+        # deadline-aware admission: with service history (EWMA of ok
+        # per-request seconds), a request whose projected completion behind
+        # the current queue already overshoots its deadline is refused NOW —
+        # cheaper for everyone than decoding it into a guaranteed expiry
+        if request.deadline is not None and self._service_ewma > 0.0:
+            projected = now + self._service_ewma * (
+                1.0 + self._queue.count / self.max_batch)
+            if projected > request.deadline:
+                return self._refuse(handle, STATUS_REJECTED, (
+                    f"deadline unmeetable: projected completion {projected:.3f}"
+                    f" > deadline {request.deadline:.3f} at queue depth "
+                    f"{self._queue.count} (service est "
+                    f"{self._service_ewma:.3f}s)"))
         cost = bucket_kv_bytes(self.params, self.heads, bucket,
                                self.compute_dtype)
         reason = self._queue.try_admit(cost)
         if reason is not None:
+            # a drain/close-shut gate is a deterministic shutting_down
+            # Result (the caller can failover/retry elsewhere); overload
+            # stays a rejection with the backpressure reason. Matching the
+            # RETURNED reason (the close reason never changes once set)
+            # keeps a "queue full" verdict that raced a concurrent drain
+            # labeled as the backpressure it was
+            if reason == self._queue.closed_reason:
+                return self._refuse(handle, STATUS_SHUTTING_DOWN, reason)
             return self._refuse(handle, STATUS_REJECTED, reason)
         entry = _Entry(request, handle, bucket, cost, now, trace=ctx)
         with self._cond:
@@ -355,11 +495,18 @@ class ServeEngine:
                 admitted = False
             else:
                 self._former.add(entry)
+                if self._idle:
+                    # an IDLE worker's heartbeat is legitimately old (it
+                    # blocks in cond.wait): restart the watchdog window at
+                    # admission so the wakeup isn't a false positive. A
+                    # busy (possibly wedged) worker is NOT idle — traffic
+                    # must never keep refreshing a dead worker's pulse
+                    self._heartbeat = time.monotonic()
                 self._cond.notify_all()
                 admitted = True
-        if not admitted:  # raced with close(): resolve, don't strand
+        if not admitted:  # raced with drain()/close(): resolve, don't strand
             self._queue.release(cost)
-            return self._refuse(handle, STATUS_REJECTED,
+            return self._refuse(handle, STATUS_SHUTTING_DOWN,
                                 "engine is shutting down")
         self.metrics.record_enqueue(request.rid, bucket, self._queue.count)
         self.metrics.record_queue(self._queue.count,
@@ -379,20 +526,73 @@ class ServeEngine:
 
     # ----------------------------------------------------------- worker loop
 
-    def _run(self) -> None:
+    def _run(self, gen: int = 0) -> None:
         if self.rowlevel:
-            self._run_rowlevel()
+            self._run_rowlevel(gen)
         else:
-            self._run_gang()
+            self._run_gang(gen)
 
-    def _run_gang(self) -> None:
+    def _crash_handler(self, exc: BaseException, held: list,
+                       gen: int) -> bool:
+        """A worker generation is dying with ``held`` entries in hand.
+        Supervised (``_on_crash`` installed, engine still serving): stash
+        the undone entries for :meth:`_recover`, kick the supervisor, and
+        return True — the worker exits quietly and the engine KEEPS
+        accepting (requests queue up behind the restart). Unsupervised:
+        the legacy contract — fail everything held plus the queued backlog
+        with ``error`` Results so no submitter is ever stranded, and
+        return False so the thread log still sees the exception. A
+        SUPERSEDED generation dying late exits quietly without stashing —
+        its entries were already requeued or failed by the recovery that
+        superseded it, and a spurious stash would restart (and burn a
+        retry attempt of) the healthy current generation."""
+        cb = leftovers = None
+        with self._cond:
+            if self._gen != gen:
+                return True  # stale straggler: recovery already ran
+            undone = []
+            seen = set()
+            for e in held:
+                if id(e) in seen or e.handle.done() or e.superseded:
+                    continue
+                seen.add(id(e))
+                undone.append(e)
+            supervised = (self._on_crash is not None
+                          and self._state in ("running", "draining"))
+            if supervised:
+                self._crash = (exc, undone)
+                cb = self._on_crash
+            else:
+                leftovers = self._former.take_all()
+                self._state = "closing"
+            self._inflight = []
+            self._claimed = []
+        self._flight_dump("worker-died")
+        if supervised:
+            try:
+                cb()
+            except Exception:  # the supervisor's poll loop still catches it
+                pass
+            return True
+        for e in leftovers + undone:
+            if not e.handle.done():
+                self._retire(e, Result(e.request.rid, STATUS_ERROR,
+                                       reason="serving worker died"))
+        return False
+
+    def _run_gang(self, gen: int) -> None:
         inflight = []
         try:
             while True:
-                self._heartbeat = time.monotonic()
+                if self._gen == gen:  # a superseded straggler must never
+                    self._heartbeat = time.monotonic()  # fake a live pulse
+                faults.fire("serve.worker_crash",
+                            path=threading.current_thread().name)
                 batch = None
                 with self._cond:
                     while True:
+                        if self._gen != gen:
+                            return  # superseded by a recovery
                         if self._state == "closing":
                             return
                         draining = self._state == "draining"
@@ -403,6 +603,7 @@ class ServeEngine:
                         if draining:
                             return  # nothing pending; in-flight is us
                         hint = batch[1]
+                        self._idle = True
                         if self._real_clock:
                             # submit/drain/close all notify — idle waits
                             # need no polling on the real clock
@@ -413,27 +614,46 @@ class ServeEngine:
                             self._cond.wait(
                                 _POLL_CAP_S if hint is None
                                 else min(max(hint, 1e-4), _POLL_CAP_S))
-                inflight = batch[1]
+                        self._idle = False
+                        if self._gen == gen:
+                            self._heartbeat = time.monotonic()
+                    inflight = batch[1]
+                    self._inflight = inflight
                 self._execute(*batch)
                 inflight = []
-        except BaseException:  # pragma: no cover - scheduler invariant
-            # a dying worker must not strand submitters on .result(): fail
-            # the batch it was holding plus everything still queued, then
-            # re-raise for the thread log (_execute absorbs ordinary
-            # Exceptions itself; this path is KeyboardInterrupt-class)
-            with self._cond:
-                leftovers = self._former.take_all()
-                self._state = "closing"
-            for e in leftovers + [e for e in inflight
-                                  if not e.handle.done()]:
-                self._retire(e, Result(e.request.rid, STATUS_ERROR,
-                                       reason="serving worker died"))
-            self._flight_dump("worker-died")
+                with self._cond:
+                    if self._gen == gen:  # never clobber a successor's
+                        self._inflight = []  # in-flight mirror
+        except BaseException as exc:  # worker death: recover or fail held
+            if self._crash_handler(exc, inflight, gen):
+                return
             raise
 
     def _retire(self, entry: _Entry, result: Result) -> None:
-        entry.handle._set(result)
+        if entry.superseded:
+            return  # a retried twin owns this request (and its budget) now
+        if entry.attempt > 1:
+            result.metrics.setdefault("attempt", entry.attempt)
+        try:
+            entry.handle._set(result)
+        except RuntimeError:
+            # lost the exactly-once race to a stale worker generation's
+            # twin — the winner released the budget and recorded the result
+            return
         self._queue.release(entry.cost)
+        if result.status == STATUS_OK:
+            total = result.metrics.get("total_s")
+            if total is not None:
+                # EWMA of per-request SERVICE time — total minus queue wait
+                # (the deadline-admission projection multiplies this by the
+                # queue depth, so feeding end-to-end total_s would count
+                # queueing twice and over-reject meetable deadlines, and a
+                # single post-recovery straggler would poison the estimate)
+                svc = max(total - (result.metrics.get("queue_s") or 0.0),
+                          0.0)
+                self._service_ewma = (svc if self._service_ewma == 0.0
+                                      else 0.8 * self._service_ewma
+                                      + 0.2 * svc)
         # re-activate the request's span on whichever thread retires it, so
         # the result record joins the request's trace
         with obs_trace.use(entry.trace):
@@ -442,26 +662,40 @@ class ServeEngine:
                 bucket=result.metrics.get("bucket"),
                 queue_s=result.metrics.get("queue_s"),
                 total_s=result.metrics.get("total_s"),
-                ttft_s=result.metrics.get("ttft_s"))
+                ttft_s=result.metrics.get("ttft_s"),
+                attempt=entry.attempt)
         self.metrics.record_queue(self._queue.count,
                                   self._queue.bytes_in_flight)
 
     # ------------------------------------------------- row-level scheduler
 
-    def _run_rowlevel(self) -> None:
+    def _run_rowlevel(self, gen: int) -> None:
         """The slot-step loop: each iteration refills freed slots from the
         queue (prefill-on-admit), retires finished/expired rows, and runs
         one decode step per bucket with live rows. ``pools`` maps bucket ->
         SlotPool and persists across iterations — the KV slab never leaves
-        the device between steps."""
+        the device between steps. ``self._pools``/``self._claimed`` mirror
+        the worker's hands so a supervisor recovering a STUCK generation
+        (watchdog timeout — the thread is alive but unreachable) can still
+        find every in-flight entry to requeue."""
         pools: dict[tuple, object] = {}
+        with self._cond:
+            if self._gen != gen:
+                return  # superseded before the first iteration: a late-
+                # starting thread must not clobber its successor's mirrors
+            self._pools = pools
         claimed: list[_Entry] = []
         try:
             while True:
-                self._heartbeat = time.monotonic()
+                if self._gen == gen:  # a superseded straggler must never
+                    self._heartbeat = time.monotonic()  # fake a live pulse
+                faults.fire("serve.worker_crash",
+                            path=threading.current_thread().name)
                 claimed = []
                 with self._cond:
                     while True:
+                        if self._gen != gen:
+                            return  # superseded by a recovery
                         if self._state == "closing":
                             # the live slots are the work in flight: finish
                             # them (close() already emptied the former)
@@ -479,25 +713,24 @@ class ServeEngine:
                         # no max_wait ripening in row-level mode: wait for
                         # a submit/drain/close notify (poll-capped under an
                         # injected clock, as in the gang loop)
+                        self._idle = True
                         self._cond.wait(None if self._real_clock
                                         else _POLL_CAP_S)
+                        self._idle = False
+                        if self._gen == gen:
+                            self._heartbeat = time.monotonic()
+                    self._claimed = claimed
                 self._admit_rowlevel(pools, claimed)
                 claimed = []
+                with self._cond:
+                    if self._gen == gen:  # never clobber a successor's
+                        self._claimed = []  # claimed mirror
                 self._step_rowlevel(pools)
-        except BaseException:  # pragma: no cover - scheduler invariant
-            # as in the gang loop: a dying worker fails everything it was
-            # holding — claimed-but-unslotted entries, live slots, and the
-            # still-queued backlog — so no submitter is stranded
-            with self._cond:
-                leftovers = self._former.take_all()
-                self._state = "closing"
+        except BaseException as exc:  # worker death: recover or fail held
             live = [p.entries[i] for p in pools.values()
                     for i in p.live_slots()]
-            for e in leftovers + claimed + live:
-                if not e.handle.done():
-                    self._retire(e, Result(e.request.rid, STATUS_ERROR,
-                                           reason="serving worker died"))
-            self._flight_dump("worker-died")
+            if self._crash_handler(exc, claimed + live, gen):
+                return
             raise
 
     def _claim_rowlevel(self, pools) -> list[_Entry]:
@@ -670,12 +903,36 @@ class ServeEngine:
         pool.release(slot)
         self._retire(e, result)
 
+    def _requeue(self, entry: _Entry, reason: str) -> None:
+        """Park a failed attempt back in the former for its next attempt
+        (the caller checked ``attempts_left``). The admission reservation
+        is CARRIED — never released, never re-charged — so a parked retry
+        holds exactly its one slot of the queue depth and KV HBM budget.
+        On a shutting-down engine the retry would never be claimed, so it
+        retires with the failure instead of stranding."""
+        twin = entry.retry()
+        with self._cond:
+            requeued = self._state in ("running", "draining")
+            if requeued:
+                self._former.add(twin)
+                self._cond.notify_all()
+        if not requeued:
+            self._retire(twin, Result(
+                twin.request.rid, STATUS_ERROR,
+                reason=f"{reason} (engine shutting down before retry)"))
+            return
+        with obs_trace.use(entry.trace):
+            self.metrics.record_retry(entry.request.rid, twin.attempt,
+                                      entry.request.max_attempts, reason)
+
     def _fail_pool(self, pools, bucket, exc: Exception) -> None:
-        """A decode step died: fail ONLY that step's live rows with error
-        Results and leave the slot pool consistent (slots freed, budget
-        released). If the failed call consumed the donated slab (a genuine
-        post-dispatch failure, not an injected fault raised before launch),
-        drop the pool — it is rebuilt zeroed on the next admission."""
+        """A decode step died: rows with attempt budget left requeue for a
+        transparent retry; the rest fail with error Results. Either way
+        ONLY that step's live rows are touched and the slot pool stays
+        consistent (slots freed, budget accounted exactly once). If the
+        failed call consumed the donated slab (a genuine post-dispatch
+        failure, not an injected fault raised before launch), drop the pool
+        — it is rebuilt zeroed on the next admission."""
         pool = pools[bucket]
         reason = f"decode step failed: {type(exc).__name__}: {exc}"
         self.flight.record("decode_fault", bucket=list(bucket),
@@ -684,7 +941,12 @@ class ServeEngine:
                            compiles=_compile_count())
         now = self._clock()
         for i in pool.live_slots():
-            self._retire_row(pool, i, STATUS_ERROR, now, reason=reason)
+            e = pool.entries[i]
+            if e.attempts_left():
+                pool.release(i)
+                self._requeue(e, reason)
+            else:
+                self._retire_row(pool, i, STATUS_ERROR, now, reason=reason)
         if self._slab_lost(pool):
             pools.pop(bucket)
         # the black box lands NOW, while the final iterations are still in
@@ -692,27 +954,119 @@ class ServeEngine:
         self._flight_dump("decode-step-failed")
 
     def _admit_failure(self, pools, entry: _Entry, exc: Exception) -> None:
-        """A prefill died: the entry being admitted gets an error Result;
-        co-resident live rows survive unless the failed call consumed the
-        donated slab, in which case they fail too and the pool is dropped."""
+        """A prefill died: the entry being admitted retries within its
+        attempt budget, else gets an error Result; co-resident live rows
+        survive unless the failed call consumed the donated slab, in which
+        case they fail/retry too and the pool is dropped."""
         now = self._clock()
         reason = f"prefill failed: {type(exc).__name__}: {exc}"
-        self._retire(entry, Result(
-            entry.request.rid, STATUS_ERROR, reason=reason,
-            metrics={"bucket": entry.bucket, "queue_s": entry.queue_s,
-                     "total_s": now - entry.enq_t}))
+        if entry.attempts_left():
+            self._requeue(entry, reason)
+        else:
+            self._retire(entry, Result(
+                entry.request.rid, STATUS_ERROR, reason=reason,
+                metrics={"bucket": entry.bucket, "queue_s": entry.queue_s,
+                         "total_s": now - entry.enq_t}))
         self.flight.record("prefill_fault", bucket=list(entry.bucket),
                            rid=entry.request.rid, error=reason,
                            queue_depth=self._queue.count,
                            compiles=_compile_count())
         pool = pools.get(entry.bucket)
         if pool is not None and self._slab_lost(pool):
+            lost = f"slab lost to a failed prefill: {reason}"
             for i in pool.live_slots():
-                self._retire_row(pool, i, STATUS_ERROR, now,
-                                 reason=f"slab lost to a failed prefill: "
-                                        f"{reason}")
+                e = pool.entries[i]
+                if e.attempts_left():
+                    pool.release(i)
+                    self._requeue(e, lost)
+                else:
+                    self._retire_row(pool, i, STATUS_ERROR, now, reason=lost)
             pools.pop(entry.bucket)
         self._flight_dump("prefill-failed")
+
+    # ------------------------------------------------- supervised recovery
+
+    def attach_supervisor(self, on_crash) -> None:
+        """Install the supervisor's crash kick: while set, a dying worker
+        stashes its undone entries for :meth:`_recover` instead of failing
+        them, and calls ``on_crash()`` so recovery starts promptly."""
+        self._on_crash = on_crash
+
+    def detach_supervisor(self) -> None:
+        self._on_crash = None
+
+    def _recover(self, reason: str, respawn: bool = True) -> dict:
+        """Recover from a dead or stuck worker generation: supersede it
+        (``_gen`` bump — a stale thread exits at its next check and can
+        never retire a superseded entry), requeue every undone in-flight
+        entry within its attempt budget (the rest fail with ``error``),
+        drop the slot pools — the slab state died with the worker; pools
+        rebuild zeroed on the next admission, the PR 4 ``is_deleted``
+        pool-rebuild path generalized — and spawn a fresh worker thread.
+        Queued (former) entries are untouched: they were never in flight.
+        ``respawn=False`` is the breaker's terminal path: supersede and
+        fail everything held, mark the old thread abandoned (it may be
+        wedged in a device call forever — shutdown must not join it), and
+        spawn nothing. Returns counts for the supervisor's EventLog
+        record."""
+        failed, twins = [], []
+        with self._cond:
+            self._gen += 1
+            gen = self._gen
+            alive = respawn and self._state in ("running", "draining")
+            if self._crash is not None:
+                stash = list(self._crash[1])
+                self._crash = None
+            else:
+                # stuck path: steal what the stale (still-alive) worker
+                # holds — its pools/claimed/inflight mirrors. The straggler
+                # mutates pool.entries WITHOUT this lock, so snapshot each
+                # list and skip holes rather than indexing live_slots()
+                # (an entry it retires concurrently shows up handle-done
+                # below and is skipped; one it frees mid-scan must not
+                # crash the recovery)
+                stash = [e for p in self._pools.values()
+                         for e in list(p.entries) if e is not None]
+                stash += list(self._claimed) + list(self._inflight)
+            self._pools = {}
+            self._inflight = []
+            self._claimed = []
+            seen = set()
+            for e in stash:
+                if id(e) in seen or e.handle.done() or e.superseded:
+                    continue
+                seen.add(id(e))
+                if alive and e.attempts_left():
+                    twin = e.retry()
+                    self._former.add(twin)
+                    twins.append(twin)
+                else:
+                    failed.append(e)
+            if alive:
+                self._thread = self._make_thread(gen)
+            elif not respawn:
+                self._abandoned = self._thread
+            started = self._started
+            # grant the fresh generation a full watchdog window: without
+            # this the stale generation's last stamp re-trips the watchdog
+            # before the new worker's first iteration, and repeated
+            # recoveries burn the attempt budget on a worker that never got
+            # to run
+            self._heartbeat = time.monotonic()
+            self._cond.notify_all()
+        for e in failed:
+            self._retire(e, Result(
+                e.request.rid, STATUS_ERROR,
+                reason=f"worker lost and attempt budget exhausted: "
+                       f"{reason}"))
+        for t in twins:
+            with obs_trace.use(t.trace):
+                self.metrics.record_retry(t.request.rid, t.attempt,
+                                          t.request.max_attempts, reason)
+        self._live_rows = 0
+        if alive and started:
+            self._thread.start()
+        return {"gen": gen, "requeued": len(twins), "failed": len(failed)}
 
     @staticmethod
     def _slab_lost(pool) -> bool:
@@ -779,11 +1133,14 @@ class ServeEngine:
                                compiles=_compile_count())
             done_t = self._clock()
             for e in live:
-                self._retire(e, Result(
-                    e.request.rid, STATUS_ERROR, reason=reason,
-                    metrics={"bucket": bucket,
-                             "queue_s": dispatch_t - e.enq_t,
-                             "total_s": done_t - e.enq_t}))
+                if e.attempts_left():
+                    self._requeue(e, reason)
+                else:
+                    self._retire(e, Result(
+                        e.request.rid, STATUS_ERROR, reason=reason,
+                        metrics={"bucket": bucket,
+                                 "queue_s": dispatch_t - e.enq_t,
+                                 "total_s": done_t - e.enq_t}))
             self._live_rows = 0
             self._flight_dump("batch-failed")
             return

@@ -22,8 +22,11 @@ picked up) under the single kind ``"serve"`` with an ``ev`` discriminator:
                latency), ``tok_s`` — the per-step occupancy stream is how
                slot refill is asserted (a finished row's slot shows
                occupied again on the next step's record)
+``retry``      ``rid``, ``attempt`` (the attempt about to run),
+               ``max_attempts``, ``reason`` — one failed attempt re-queued
 ``result``     ``rid``, ``status``, ``bucket``, ``queue_s``, ``ttft_s``,
-               ``total_s``
+               ``total_s``; retried requests add ``attempt`` (the final,
+               serving attempt — latency is attributed to it)
 =============  ===========================================================
 
 The engine activates each request's span context around the rid-carrying
@@ -112,6 +115,7 @@ class ServeMetrics:
         self.completed = 0
         self.errors = 0
         self.shut_down = 0
+        self.retries = 0
         self.batches = 0
         self.steps = 0
         self.new_tokens = 0
@@ -154,6 +158,10 @@ class ServeMetrics:
             "marlin_serve_total_seconds", "Submit-to-result latency")
         self._m_step = reg.histogram(
             "marlin_serve_step_seconds", "Row-level decode-step wall time")
+        self._m_retries = reg.counter(
+            "marlin_serve_retries_total",
+            "Failed attempts transparently re-queued (decode/prefill fault "
+            "or worker crash) within the request's max_attempts budget")
 
     def _emit(self, **fields) -> None:
         log = self._log or get_default_event_log()
@@ -250,10 +258,23 @@ class ServeMetrics:
                    seconds=seconds,
                    tok_s=round(rows / max(seconds, 1e-9), 2))
 
+    def record_retry(self, rid: int, attempt: int, max_attempts: int,
+                     reason: str) -> None:
+        """One failed attempt re-queued for another try. The request stays
+        admitted (no terminal counter moves); latency/TTFT land only with
+        the final attempt's result — a retried request is attributed to the
+        attempt that actually served it."""
+        with self._lock:
+            self.retries += 1
+        self._m_retries.inc()
+        self._emit(ev="retry", rid=rid, attempt=attempt,
+                   max_attempts=max_attempts, reason=reason)
+
     def record_result(self, rid: int, status: str, bucket=None,
                       queue_s: float | None = None,
                       total_s: float | None = None,
-                      ttft_s: float | None = None) -> None:
+                      ttft_s: float | None = None,
+                      attempt: int = 1) -> None:
         with self._lock:
             if status == "ok":
                 self.completed += 1
@@ -282,6 +303,8 @@ class ServeMetrics:
         if ttft_s is not None:
             self._m_ttft.observe(ttft_s)
         fields = {"ev": "result", "rid": rid, "status": status}
+        if attempt > 1:
+            fields["attempt"] = attempt
         if bucket is not None:
             fields["bucket"] = list(bucket)
         if queue_s is not None:
@@ -308,6 +331,7 @@ class ServeMetrics:
                 "submitted": self.submitted, "rejected": self.rejected,
                 "expired": self.expired, "completed": self.completed,
                 "errors": self.errors, "shut_down": self.shut_down,
+                "retries": self.retries,
                 "batches": self.batches, "steps": self.steps,
                 "new_tokens": self.new_tokens,
                 "busy_s": round(self.busy_s, 6),

@@ -68,11 +68,29 @@ class Request:
     generated tokens, ending with the eos. Detection looks only at
     GENERATED tokens — an eos-valued token inside the prompt or its pad
     region never stops a row. The gang fallback runs its fused program to
-    completion and ignores ``eos``."""
+    completion and ignores ``eos``.
+
+    ``deadline_s`` is the *relative* form of ``deadline``: seconds from
+    submission, resolved to an absolute engine-clock deadline inside
+    ``submit()`` (at most one of the two may be set; with neither set,
+    ``config.serve_default_deadline_s`` applies when configured). The
+    resolved deadline survives router failover and worker restarts — a
+    retried attempt does not get a fresh budget.
+
+    ``max_attempts`` is the request's total execution budget: rows failed
+    by a decode-step/prefill fault or lost to a worker crash are
+    transparently re-queued until they have consumed ``max_attempts``
+    attempts, then retired with an ``error`` Result. The default (1) keeps
+    the pre-resilience semantics — first failure is final. Replays are
+    attempt-independent: greedy retries are bit-identical to an
+    uninterrupted run, sampled retries re-derive the same per-row
+    ``fold_in(key(seed), step)`` stream (docs/robustness.md)."""
 
     prompt: Any
     steps: int
     deadline: float | None = None
+    deadline_s: float | None = None
+    max_attempts: int = 1
     priority: int = 0
     temperature: float = 0.0
     top_p: float | None = None
@@ -87,6 +105,12 @@ class Request:
             raise ValueError("empty prompt")
         if self.steps < 1:
             raise ValueError(f"steps must be >= 1, got {self.steps}")
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.deadline is not None and self.deadline_s is not None:
+            raise ValueError("set deadline (absolute) or deadline_s "
+                             "(relative to submit), not both")
 
 
 @dataclasses.dataclass
@@ -194,6 +218,14 @@ class AdmissionQueue:
         with self._lock:
             if self._closed_reason is None:
                 self._closed_reason = reason
+
+    @property
+    def closed_reason(self) -> str | None:
+        """The drain/shutdown reason once the gate is shut, else None —
+        submit() turns post-drain arrivals into deterministic
+        ``shutting_down`` Results instead of generic rejections."""
+        with self._lock:
+            return self._closed_reason
 
     @property
     def count(self) -> int:

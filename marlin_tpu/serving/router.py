@@ -1,0 +1,360 @@
+"""Multi-replica serving router: obs-fed load balancing over N supervised
+:class:`~marlin_tpu.serving.engine.ServeEngine` replicas, with failover and
+drain-safe rolling restarts.
+
+One engine is one worker loop on (implicitly) one device set; the ROADMAP's
+"millions of users" story needs N of them behind one front door. A
+:class:`Router` owns that front door:
+
+- **Routing** is power-of-two-choices over the replicas that are *ready*
+  (engine accepting, supervisor breaker closed, not mid-restart): pick two
+  distinct candidates at random, route to the less loaded by the same
+  queue-depth gauge ``/metrics`` exports (``AdmissionQueue.count`` — the
+  obs-fed signal, read directly so routing needs no scrape). Two random
+  choices beat one by an exponential load-spread factor and beat
+  full-scan-least-loaded by not herding every submit onto one replica
+  between gauge updates.
+- **Failover**: a replica that rejects (overload), reports shutting-down,
+  or fails outright (the ``serve.router_route`` fault point simulates
+  this) is skipped for this request and the remaining replicas are tried
+  in load order. Only when every replica refuses does the caller see a
+  terminal Result — deterministic, never an exception from a healthy
+  router.
+- **Rolling restart** (:meth:`rolling_restart`): one replica at a time is
+  pulled from rotation, drained (everything it accepted completes),
+  closed with its supervisor, rebuilt via the factory, and put back before
+  the next replica starts — the rest absorb traffic throughout, so a
+  fleet-wide restart drops zero requests and double-delivers none (the
+  per-engine exactly-once contract is untouched).
+- **One scrape target**: the router registers a single aggregated health
+  provider (each adopted engine's individual provider is unregistered —
+  a draining replica mid-rotation must NOT 503 the process while its
+  peers absorb traffic; the router reports not-ready only when NO replica
+  accepts) and publishes ``marlin_serve_replica_state{router=,replica=}``
+  (0 accepting / 1 draining / 2 restarting / 3 closed / 4 failed).
+  Per-engine serving metrics already aggregate in the process registry;
+  :meth:`snapshot` merges the per-replica ``ServeMetrics`` snapshots for
+  tests and the bench.
+
+``Router(factory, replicas=N)`` builds N engines up front via the zero-arg
+``factory`` (also used by rolling restarts); ``Router(engines=[...])``
+adopts existing engines but cannot rolling-restart without a factory.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+
+from ..config import get_config
+from ..obs.exposition import (register_health_provider,
+                              unregister_health_provider)
+from ..obs.metrics import get_registry
+from ..utils import faults
+from .request import (STATUS_REJECTED, STATUS_SHUTTING_DOWN, Request, Result,
+                      ResultHandle)
+from .supervisor import Supervisor, _emit
+
+__all__ = ["Router", "REPLICA_STATES"]
+
+_router_ids = itertools.count()
+
+#: the ``marlin_serve_replica_state`` gauge encoding
+REPLICA_STATES = {"accepting": 0, "draining": 1, "restarting": 2,
+                  "closed": 3, "failed": 4}
+
+#: handle statuses that trigger failover to the next replica (an expired
+#: deadline is final everywhere; an error Result means the request RAN)
+_FAILOVER = (STATUS_REJECTED, STATUS_SHUTTING_DOWN)
+
+
+class _Replica:
+    """One engine + its supervisor + routing state. ``routable`` is the
+    router-side gate (rolling restart pulls a replica from rotation before
+    the engine itself starts draining)."""
+
+    __slots__ = ("idx", "engine", "supervisor", "routable", "restarts")
+
+    def __init__(self, idx: int, engine, supervisor):
+        self.idx = idx
+        self.engine = engine
+        self.supervisor = supervisor
+        self.routable = True
+        self.restarts = 0
+
+    def state(self) -> str:
+        if self.supervisor is not None and self.supervisor.breaker_open:
+            return "failed"
+        eng_state = {"running": "accepting", "draining": "draining",
+                     "closing": "closed",
+                     "closed": "closed"}[self.engine._state]
+        if eng_state == "closed":
+            return "closed"
+        if not self.routable:
+            return "restarting"   # pulled from rotation, being rebuilt
+        return eng_state
+
+    def ready(self) -> bool:
+        return self.state() == "accepting"
+
+    def load(self) -> int:
+        return self.engine._queue.count
+
+
+class Router:
+    """Route :class:`Request` submissions across N engine replicas.
+
+    ``factory`` is a zero-arg callable returning a fresh, started
+    :class:`ServeEngine`; ``replicas`` defaults from
+    ``config.serve_replicas``. Pass ``engines=[...]`` to adopt
+    pre-built engines instead (``factory`` then remains optional but is
+    required for :meth:`rolling_restart`). ``supervise=True`` (default)
+    wraps every replica in a :class:`~.supervisor.Supervisor`;
+    ``supervisor_kw`` tunes it (watchdog_s, restart_max, ...). ``rng``
+    seeds the power-of-two choice for deterministic tests.
+
+    Thread-safe: ``submit`` may be called from any number of threads;
+    ``rolling_restart``/``drain``/``close`` serialize against each other.
+    Usable as a context manager (``close()`` on exit)."""
+
+    def __init__(self, factory=None, replicas: int | None = None, *,
+                 engines=None, supervise: bool = True,
+                 supervisor_kw: dict | None = None, rng=None, log=None,
+                 warmup: bool = False):
+        if factory is None and engines is None:
+            raise ValueError("Router needs a factory or engines=[...]")
+        self._factory = factory
+        self._supervise = supervise
+        self._supervisor_kw = dict(supervisor_kw or {})
+        self._log = log
+        self._rng = rng if rng is not None else random.Random()
+        self._lock = threading.Lock()        # replica list + lifecycle
+        self._restart_lock = threading.Lock()  # one rotation at a time
+        self._closed = False
+        self._name = f"marlin-router-{next(_router_ids)}"
+        reg = get_registry()
+        self._m_replica_state = reg.gauge(
+            "marlin_serve_replica_state",
+            "Router replica state (0 accepting / 1 draining / 2 restarting "
+            "/ 3 closed / 4 failed)", labelnames=("router", "replica"))
+        if engines is None:
+            n = int(get_config().serve_replicas if replicas is None
+                    else replicas)
+            if n < 1:
+                raise ValueError(f"replicas must be >= 1, got {n}")
+            engines = [factory() for _ in range(n)]
+        self._replicas = [self._adopt(i, eng)
+                          for i, eng in enumerate(engines)]
+        if warmup:
+            for rep in self._replicas:
+                rep.engine.warmup()
+        register_health_provider(self._name, self._health_info)
+        self._publish_states()
+
+    # -------------------------------------------------------------- plumbing
+
+    def _adopt(self, idx: int, engine) -> _Replica:
+        # the router is THE scrape target: fold the engine's readiness into
+        # the aggregate view so one draining replica cannot 503 a process
+        # whose other replicas are absorbing its traffic
+        unregister_health_provider(engine._name)
+        sup = Supervisor(engine, log=self._log,
+                         **self._supervisor_kw) if self._supervise else None
+        return _Replica(idx, engine, sup)
+
+    def _emit(self, **fields) -> None:
+        _emit(self._log, **fields)
+
+    def _publish_states(self) -> None:
+        with self._lock:
+            reps = list(self._replicas)
+        for rep in reps:
+            self._m_replica_state.labels(
+                router=self._name, replica=rep.idx).set(
+                    REPLICA_STATES[rep.state()])
+
+    # --------------------------------------------------------------- routing
+
+    def _candidates(self) -> list[_Replica]:
+        """Ready replicas in routing preference order: power-of-two-choices
+        first (two distinct random picks, less loaded first), then the rest
+        by load — the failover order."""
+        with self._lock:
+            ready = [r for r in self._replicas if r.ready()]
+        if len(ready) <= 2:
+            return sorted(ready, key=lambda r: r.load())
+        a, b = self._rng.sample(ready, 2)
+        first = sorted([a, b], key=lambda r: r.load())
+        rest = sorted((r for r in ready if r is not a and r is not b),
+                      key=lambda r: r.load())
+        return first + rest
+
+    def submit(self, request: Request) -> ResultHandle:
+        """Route one request: exactly one terminal Result, always. Tries
+        the power-of-two pick, then fails over across every remaining
+        ready replica on rejection / shutdown / route failure; only when
+        all refuse does the caller see the last refusal (or a synthesized
+        ``rejected`` Result when no replica is ready at all)."""
+        last = None
+        for rep in self._candidates():
+            try:
+                faults.fire("serve.router_route", path=f"replica-{rep.idx}")
+                h = rep.engine.submit(request)
+            except Exception as exc:
+                self._emit(ev="route_failover", router=self._name,
+                           replica=rep.idx, rid=request.rid,
+                           reason=f"{type(exc).__name__}: {exc}")
+                continue
+            if h.done() and h.result().status in _FAILOVER:
+                last = h
+                self._emit(ev="route_failover", router=self._name,
+                           replica=rep.idx, rid=request.rid,
+                           reason=h.result().reason)
+                continue
+            return h
+        if last is not None:
+            return last
+        handle = ResultHandle(request)
+        handle._set(Result(
+            request.rid, STATUS_REJECTED,
+            reason=f"no ready replica ({self._name}: "
+                   f"{[r.state() for r in self._replicas]})"))
+        return handle
+
+    def submit_many(self, requests) -> list[ResultHandle]:
+        return [self.submit(r) for r in requests]
+
+    # ------------------------------------------------------------- lifecycle
+
+    def rolling_restart(self) -> dict:
+        """Drain-safe fleet rotation: one replica at a time leaves rotation,
+        drains (all accepted work completes), closes with its supervisor,
+        is rebuilt via the factory, and rejoins before the next leaves —
+        peers absorb traffic throughout. Returns per-replica timings.
+        Requires a factory; serialized against concurrent rotations."""
+        if self._factory is None:
+            raise RuntimeError("rolling_restart needs the Router built "
+                               "with a factory")
+        out = {}
+        with self._restart_lock:
+            for idx in range(len(self._replicas)):
+                t0 = time.monotonic()
+                with self._lock:
+                    if self._closed:
+                        break  # close() won the race; nothing to rotate
+                    rep = self._replicas[idx]
+                    rep.routable = False
+                self._publish_states()
+                self._emit(ev="replica_rotate", router=self._name,
+                           replica=idx, phase="drain")
+                # drain FIRST, supervisor still attached: a worker crash
+                # mid-drain is recovered and the accepted work completes
+                # (drain's join waits out supervised recoveries) — closing
+                # the supervisor first would turn that crash into failed
+                # requests, breaking the zero-dropped rotation guarantee
+                rep.engine.drain()
+                if rep.supervisor is not None:
+                    rep.supervisor.close()
+                rep.engine.close()
+                fresh = self._factory()
+                with self._lock:
+                    self._replicas[idx] = self._adopt(idx, fresh)
+                    self._replicas[idx].restarts = rep.restarts + 1
+                self._publish_states()
+                out[idx] = round(time.monotonic() - t0, 6)
+                self._emit(ev="replica_rotate", router=self._name,
+                           replica=idx, phase="done", seconds=out[idx])
+        return out
+
+    def drain(self) -> None:
+        """Drain every replica (concurrently — they are independent) and
+        stop routing. Terminal. Serializes behind an in-flight rotation —
+        the documented drain/close/rolling_restart mutual exclusion; a
+        drain racing the rotation's replica swap would miss the fresh
+        engine."""
+        with self._restart_lock:
+            with self._lock:
+                reps = list(self._replicas)
+                for rep in reps:
+                    rep.routable = False
+            threads = [threading.Thread(target=rep.engine.drain)
+                       for rep in reps]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        self._publish_states()
+
+    def close(self) -> None:
+        """Close supervisors and engines; unregister the health provider.
+        Idempotent; waits out an in-flight rolling restart so a
+        freshly-built replica can never be swapped in (and leaked) after
+        the close."""
+        with self._restart_lock:
+            with self._lock:
+                if self._closed:
+                    return
+                self._closed = True
+                reps = list(self._replicas)
+                for rep in reps:
+                    rep.routable = False
+            for rep in reps:
+                if rep.supervisor is not None:
+                    rep.supervisor.close()
+                rep.engine.close()
+        self._publish_states()
+        unregister_health_provider(self._name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --------------------------------------------------------- introspection
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(r.engine.pending() for r in self._replicas)
+
+    def _health_info(self) -> dict:
+        """The aggregated /healthz payload: ready while ANY replica
+        accepts (a rolling restart must not 503 the process), with the
+        per-replica detail inline."""
+        with self._lock:
+            reps = list(self._replicas)
+        detail = []
+        for rep in reps:
+            info = rep.engine._health_info()
+            info["name"] = rep.engine._name
+            info["replica"] = rep.idx
+            info["state"] = rep.state() if rep.state() != "accepting" \
+                else info["state"]
+            if rep.supervisor is not None:
+                info["supervisor"] = rep.supervisor.info()
+            detail.append(info)
+        any_ready = any(rep.ready() for rep in reps)
+        return {"state": "accepting" if any_ready else "closed",
+                "replicas": detail}
+
+    def snapshot(self) -> dict:
+        """Merged per-replica ``ServeMetrics.snapshot()`` counters plus the
+        per-replica list — the router-level accounting the bench records.
+        The replica list is copied under the lock so a concurrent rotation
+        cannot be read mid-swap (counters of a replica retired by the
+        rotation are gone — snapshot totals span the CURRENT engines)."""
+        with self._lock:
+            reps = list(self._replicas)
+        snaps = [(rep.idx, rep.engine.metrics.snapshot()) for rep in reps]
+        agg: dict = {"replicas": {i: s for i, s in snaps}}
+        for key in ("submitted", "rejected", "expired", "completed",
+                    "errors", "shut_down", "retries", "batches", "steps",
+                    "new_tokens"):
+            agg[key] = sum(s[key] for _, s in snaps)
+        busy = sum(s["busy_s"] for _, s in snaps)
+        agg["busy_s"] = round(busy, 6)
+        agg["tok_s"] = (round(agg["new_tokens"] / busy, 2) if busy > 0
+                        else None)
+        return agg

@@ -37,6 +37,19 @@ point               fires from
                     ``path="bucket-<P>x<steps>"``) — a raise fails only
                     that step's live rows with ``error`` Results and leaves
                     the slot pool consistent; queued requests keep serving
+``serve.worker_crash``
+                    the serving worker loop, once per iteration OUTSIDE the
+                    per-batch/per-step failure envelopes (ctx carries
+                    ``path=<worker thread name>``) — a raise kills the whole
+                    worker thread, the failure class
+                    :class:`~marlin_tpu.serving.supervisor.Supervisor`
+                    exists to recover from (unsupervised engines fail all
+                    held requests with ``error`` Results, as before)
+``serve.router_route``
+                    :meth:`~marlin_tpu.serving.router.Router.submit`, once
+                    per replica attempt (ctx carries ``path="replica-<i>"``)
+                    — a raise marks that replica failed for this request
+                    and the router fails over to the next candidate
 ==================  =========================================================
 
 Behaviors are :class:`Fault` subclasses — :class:`RaiseFault` (raise once /
@@ -70,7 +83,7 @@ __all__ = [
 KNOWN_POINTS = frozenset({
     "ckpt.write", "ckpt.manifest", "fs.open", "fs.list", "step.run",
     "device.probe", "prefetch.produce", "serve.enqueue", "serve.step",
-    "serve.decode_step",
+    "serve.decode_step", "serve.worker_crash", "serve.router_route",
 })
 
 

@@ -367,9 +367,10 @@ def test_close_retires_queued_with_shutting_down(params):
         r = h.result(timeout=1)
         assert r.status == STATUS_SHUTTING_DOWN and "closed" in r.reason
     assert eng.pending() == 0
-    # close is terminal for admission too
+    # close is terminal for admission too: a deterministic shutting_down
+    # Result, not a generic rejection (the router's failover signal)
     r = eng.submit(Request(prompt=[9], steps=1)).result(timeout=1)
-    assert r.status == STATUS_REJECTED
+    assert r.status == STATUS_SHUTTING_DOWN
 
 
 def test_serve_step_fault_fails_batch_and_engine_recovers(params):
@@ -496,7 +497,45 @@ def test_drain_idempotent_and_usable_from_context(params):
         assert h.result(timeout=1).status == STATUS_OK
         eng.drain()   # terminal + idempotent
         r = eng.submit(Request(prompt=[5], steps=1)).result(timeout=1)
-        assert r.status == STATUS_REJECTED and "draining" in r.reason
+        assert r.status == STATUS_SHUTTING_DOWN and "draining" in r.reason
+
+
+def test_drain_vs_concurrent_submit_race(params):
+    """Regression (satellite): submits racing a drain must each get a
+    deterministic terminal Result — completed if they made it in,
+    ``shutting_down`` if they arrived after the gate shut — NEVER a
+    silently-dropped request. Four submitter threads hammer while the main
+    thread drains mid-burst."""
+    eng = _engine(params, queue_depth=4096)
+    handles, lock = [], threading.Lock()
+    go = threading.Event()
+
+    def submitter(seed):
+        go.wait()
+        for i in range(25):
+            h = eng.submit(Request(prompt=[1 + (seed + i) % 8], steps=1))
+            with lock:
+                handles.append(h)
+
+    threads = [threading.Thread(target=submitter, args=(i,))
+               for i in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        go.set()
+        eng.drain()   # races the submitters by construction
+        for t in threads:
+            t.join()
+        assert len(handles) == 100
+        statuses = [h.result(timeout=60).status for h in handles]
+    finally:
+        eng.close()
+    # every handle terminal, only the two deterministic outcomes
+    assert set(statuses) <= {STATUS_OK, STATUS_SHUTTING_DOWN}, set(statuses)
+    snap = eng.metrics.snapshot()
+    assert snap["completed"] == statuses.count(STATUS_OK)
+    assert eng.pending() == 0
+    assert eng._queue.bytes_in_flight == 0
 
 
 # ---------------------------------------------------- row-level scheduler
@@ -687,6 +726,45 @@ def test_expiring_burst_releases_admission_budget(params, rowlevel):
         ok = eng.submit(Request(prompt=[1, 2], steps=2))
         eng.drain()
         assert ok.result(timeout=60).status == STATUS_OK
+    finally:
+        eng.close()
+    assert eng._queue.bytes_in_flight == 0
+
+
+def test_crash_retry_releases_admission_budget_exactly_once(params):
+    """Regression (admission accounting, the retry half of the expiring-
+    burst guarantee): a request parked between attempts must hold EXACTLY
+    its one admission reservation — never double-charged by the re-queue,
+    and fully released on its final retirement whichever attempt serves
+    it. Covers the decode-fault retry and the exhausted-budget error."""
+    cost = bucket_kv_bytes(params, HEADS, (8, 4))
+    eng = _engine(params, max_batch=2, start=False,
+                  hbm_budget_bytes=10 * cost)
+    try:
+        eng.warmup()
+        hs = [eng.submit(Request(prompt=[1, 2], steps=3, max_attempts=2))
+              for _ in range(2)]
+        assert eng._queue.bytes_in_flight == 2 * cost
+        with faults.injected("serve.decode_step", RaiseFault(times=1)):
+            eng.start()
+            for h in hs:
+                r = h.result(timeout=60)
+                assert r.status == STATUS_OK, (r.status, r.reason)
+                assert r.metrics["attempt"] == 2
+        snap = eng.metrics.snapshot()
+        assert snap["retries"] == 2 and snap["completed"] == 2
+        # exhausting the budget errors exactly once, still one release
+        with faults.injected("serve.decode_step", RaiseFault(times=2)):
+            bad = eng.submit(Request(prompt=[3, 4], steps=3, max_attempts=2))
+            r = bad.result(timeout=60)
+            assert r.status == STATUS_ERROR and "FaultInjected" in r.reason
+        deadline = 200   # worker releases asynchronously after _set
+        import time as _t
+        while eng._queue.bytes_in_flight and deadline:
+            _t.sleep(0.01)
+            deadline -= 1
+        assert eng._queue.bytes_in_flight == 0
+        assert eng.pending() == 0
     finally:
         eng.close()
     assert eng._queue.bytes_in_flight == 0

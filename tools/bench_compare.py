@@ -28,6 +28,11 @@ regression, it does not define one.
 Per-config overrides: ``--threshold serve_load64=0.1`` (repeatable) tightens
 or loosens one config without moving the global ``--tolerance``.
 
+``--only PREFIX`` (repeatable) restricts the gate to configs whose name
+starts with a prefix — ``--only serve`` is the serving-records gate behind
+``make -C tools serve-gate`` (a subsystem PR gates its own records without
+a full BENCH sweep on both sides).
+
 ``make bench-gate`` (tools/Makefile) runs this over the checked-in fixture
 pair; pointing NEW at ``bench_gate_regressed.json`` proves the gate fires.
 """
@@ -155,6 +160,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--threshold", action="append", default=[],
                     metavar="CONFIG=TOL",
                     help="per-config tolerance override (repeatable)")
+    ap.add_argument("--only", action="append", default=[],
+                    metavar="PREFIX",
+                    help="gate only configs whose name starts with PREFIX "
+                         "(repeatable; default: all configs)")
     ap.add_argument("--out", default=None,
                     help="also write the markdown summary here")
     args = ap.parse_args(argv)
@@ -169,6 +178,15 @@ def main(argv: list[str] | None = None) -> int:
     except (OSError, ValueError) as e:
         print(f"bench_compare: {e}", file=sys.stderr)
         return 2
+    if args.only:
+        def keep(d):
+            return {k: v for k, v in d.items()
+                    if any(k.startswith(p) for p in args.only)}
+        base, new = keep(base), keep(new)
+        if not base and not new:
+            print(f"bench_compare: no config matches --only "
+                  f"{args.only}", file=sys.stderr)
+            return 2
     rows, regressed = compare(base, new, args.tolerance, thresholds)
     md = markdown(rows, args.base, args.new)
     sys.stdout.write(md)

@@ -624,13 +624,18 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     MARLIN_BENCH_SERVE_N (requests per rate, default 64),
     MARLIN_BENCH_SERVE_BATCH (slot width, default 8),
     MARLIN_BENCH_SERVE_STEPS (decode-steps range "lo,hi", default "4,32" —
-    ragged output lengths, the traffic continuous batching exists for; the
-    gang scheduler decodes every request to the bucket's steps while
-    row-level retires at the requested steps),
+    ragged output lengths, the traffic continuous batching exists for;
+    rows retire at their requested steps),
     MARLIN_BENCH_SERVE_WARMUP=0 skips the per-bucket pre-compile (the
     first-request-pays-the-compile A/B),
-    MARLIN_BENCH_SERVE_ROWLEVEL=0 is the gang-scheduler control for the
-    row-level A/B (docs/performance.md records the pair),
+    MARLIN_BENCH_SERVE_PAGED=0 is the dense-slab control for the paged
+    KV-pool A/B (records get a `_slab` suffix; docs/performance.md records
+    the pair),
+    MARLIN_BENCH_SERVE_PREFIX_LEN=N (0 = off, the default) prepends a
+    shared N-token system prompt to every request — the prefix-cache
+    workload (records get a `_prefix` suffix; the acceptance bar is
+    prefix-cache hits > 0 and TTFT p99 down vs the `_prefix_slab` control,
+    ISSUE 8); the per-rate detail carries the hit counts,
     MARLIN_BENCH_SERVE_ROUTER=N (0 = off, the default) serves each rate
     through a Router over N supervised engine replicas instead of one bare
     engine — the resilience-layer A/B (records get a `_router` suffix;
@@ -638,8 +643,8 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     baseline at the top rate). The model
     (d_model=128, heads=8, layers=4) is sized so decode COMPUTE is
     non-trivial relative to dispatch — the serving regime; at toy sizes the
-    sweep measures Python/dispatch overhead, where a fused gang program
-    always looks best.
+    sweep measures Python/dispatch overhead, which flatters whichever
+    backend does the least host-side bookkeeping.
 
     Observability ride-along (docs/observability.md): a /metrics endpoint
     (MARLIN_BENCH_OBS_PORT, default ephemeral) is scraped DURING the first
@@ -663,9 +668,19 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     n_req = int(os.environ.get("MARLIN_BENCH_SERVE_N", 64))
     max_batch = int(os.environ.get("MARLIN_BENCH_SERVE_BATCH", 8))
     warmup = os.environ.get("MARLIN_BENCH_SERVE_WARMUP", "1") != "0"
-    rowlevel = os.environ.get("MARLIN_BENCH_SERVE_ROWLEVEL", "1") != "0"
+    paged = os.environ.get("MARLIN_BENCH_SERVE_PAGED", "1") != "0"
+    prefix_len = int(os.environ.get("MARLIN_BENCH_SERVE_PREFIX_LEN", "0"))
+    if prefix_len > 240:
+        # prompts must leave the per-request tail (8..) room inside the
+        # largest (256, ...) bucket — clamp rather than die on the first
+        # submit with a numpy low>=high error
+        log(f"MARLIN_BENCH_SERVE_PREFIX_LEN={prefix_len} clamped to 240 "
+            f"(tails need room inside the 256-token bucket)")
+        prefix_len = 240
     router_n = int(os.environ.get("MARLIN_BENCH_SERVE_ROUTER", "0"))
-    suffix = ("" if rowlevel else "_gang") + ("_router" if router_n else "")
+    suffix = (("_prefix" if prefix_len else "")
+              + ("" if paged else "_slab")
+              + ("_router" if router_n else ""))
     steps_lo, steps_hi = (int(v) for v in os.environ.get(
         "MARLIN_BENCH_SERVE_STEPS", "4,32").split(","))
     buckets = ((64, 32), (256, 32))
@@ -673,6 +688,9 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
                       layers=layers, seed=0)
     params = lm.init_params()
     rng = np.random.default_rng(0)
+    # the shared system prompt for the prefix-cache workload: fixed tokens,
+    # page-aligned-friendly length, identical across requests and sweeps
+    prefix = (np.arange(prefix_len) * 7 % vocab).astype(np.int32)
 
     events_path = os.environ.get("MARLIN_BENCH_SERVE_EVENTS") or os.path.join(
         tempfile.gettempdir(), f"marlin_serve_events{suffix}.jsonl")
@@ -689,7 +707,7 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     def make_engine():
         return ServeEngine(params, heads, buckets=buckets,
                            max_batch=max_batch, max_wait_ms=5.0,
-                           queue_depth=4 * n_req, rowlevel=rowlevel)
+                           queue_depth=4 * n_req, paged=paged)
 
     def run_rate(rate):
         nonlocal scrape
@@ -710,9 +728,15 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
                     # sleep after the last one would deflate tok/s at low
                     # rates (no request is outstanding during it)
                     time.sleep(gaps[i - 1])
-                plen = int(rng.integers(8, 192))
+                plen = int(rng.integers(8, min(192, 256 - prefix_len)))
+                prompt = rng.integers(0, vocab, plen).astype(np.int32)
+                if prefix_len:
+                    # the shared-prefix shape: one system prompt + a short
+                    # per-request tail (the prefix cache should prefill the
+                    # system prompt once per pool lifetime)
+                    prompt = np.concatenate([prefix, prompt])
                 handles.append(eng.submit(Request(
-                    prompt=rng.integers(0, vocab, plen).astype(np.int32),
+                    prompt=prompt,
                     steps=int(rng.integers(steps_lo, steps_hi + 1)))))
             scraper = None
             if not scrape:
@@ -750,14 +774,20 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
         # faults) is a degraded data point, not a sweep abort
         ms = lambda xs, q: (  # noqa: E731
             f"{percentile(xs, q) * 1e3:.0f}" if xs else "n/a")
-        sched = (f"row-level, {snap['steps']} decode steps"
-                 if rowlevel else f"gang, {snap['batches']} batches")
+        sched = (f"paged, {snap['steps']} decode steps"
+                 if paged else f"dense slab, {snap['steps']} decode steps")
+        if paged:
+            hits, misses = snap.get("prefix_hits", 0), \
+                snap.get("prefix_misses", 0)
+            sched += (f", prefix-cache {hits} hit / {misses} miss, "
+                      f"cache-resident pages {snap.get('pages_used', 0)}"
+                      f"/{snap.get('pages_total', 0)}")
         if router_n:
             sched = (f"{router_n}-replica supervised router "
                      f"({snap['retries']} retries), " + sched)
         occ = snap.get("occupancy_mean", "n/a")
-        # the gang/router controls keep their own record keys so the A/B
-        # tuple coexists in BENCH_ALL.json (the merge is keyed by config)
+        # the slab/prefix/router controls keep their own record keys so the
+        # A/B tuple coexists in BENCH_ALL.json (the merge is keyed by config)
         record(f"serve_load{rate:g}" + suffix,
                toks / span, "tok/s",
                f"{len(ok)}/{n_req} ok at {rate:g} req/s offered; p50 "
@@ -775,9 +805,9 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
         # from the engines' live decode steps across all rates.
         from marlin_tpu.obs import perf as obs_perf
 
-        # gang mode never runs lm_decode_rows — its decode program is the
-        # fused batch generate, so the gang control reads that instead
-        decode_prog = "lm_decode_rows" if rowlevel else "lm_generate_batch"
+        # the slab control runs lm_decode_rows; the paged default decodes
+        # through the block-table gather program
+        decode_prog = "lm_decode_paged" if paged else "lm_decode_rows"
         decode_rows = [r for r in obs_perf.get_program_costs().rows()
                        if r["program"] == decode_prog and r["calls"]
                        and r["roofline_frac"] is not None]
@@ -808,6 +838,10 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
             "marlin_compile_total", "marlin_prefetch_chunks_total",
             "marlin_device_memory_bytes_in_use",
             "marlin_program_roofline_frac")
+    if paged:
+        # the paging families ride only when the paged pool served
+        want += ("marlin_serve_kv_pages_total", "marlin_serve_kv_pages_used",
+                 "marlin_serve_prefix_cache_total")
     if router_n:
         # the resilience families ride only when the router/supervisors ran
         want += ("marlin_serve_retries_total", "marlin_serve_restarts_total",

@@ -102,14 +102,42 @@ class MarlinConfig:
     # one compile per sampling variant; prompts/steps round UP to the
     # smallest fitting bucket (docs/serving.md has tuning guidance).
     serve_buckets: tuple = ((64, 32), (256, 64))
-    # Row-level continuous batching (default): each bucket compiles TWO
-    # programs — slot-targeted prefill + a single-token decode step over a
-    # persistent device-resident KV slab — and the engine schedules per
-    # slot-step: finished/expired rows retire individually and freed slots
-    # refill from the queue on the very next step. False falls back to the
-    # gang scheduler (one fused program per bucket runs a whole batch to
-    # completion; rows land together). docs/serving.md compares the two.
+    # DEPRECATED (PR 8): the gang scheduler this knob used to fall back to
+    # is retired — the engine always schedules row-level (paged by default,
+    # dense-slab with serve_paged=False). Parsing is kept so old configs
+    # don't hard-fail; setting it False earns a DeprecationWarning from the
+    # engine and changes nothing.
     serve_rowlevel: bool = True
+    # Paged KV cache (default): the engine owns ONE device-resident page
+    # slab (serve_num_pages x serve_page_len KV rows per layer) shared by
+    # every bucket, rows hold block tables of pages, admission charges the
+    # request's ACTUAL pages (models/planner.request_pages) instead of the
+    # bucket worst case, full prompt pages are prefix-shared copy-on-write
+    # across requests, and long prompts prefill in serve_prefill_chunk-token
+    # chunks interleaved with decode steps. False = the dense per-slot slab
+    # scheduler (the PR 4 control; docs/serving.md compares them).
+    serve_paged: bool = True
+    # Tokens per KV page. Keep it a multiple of 8 (sublane-aligned pages —
+    # the decode gather stays on the fast path); larger pages cut block-
+    # table overhead but waste more of the last page per request and share
+    # prefixes at coarser granularity.
+    serve_page_len: int = 16
+    # Total pages in the pool (page 0 is a sacrificial dummy). 0 = auto:
+    # enough for every bucket's slab extent at full width plus slack — the
+    # dense-slab steady state, so paged-vs-slab A/Bs hold capacity equal.
+    serve_num_pages: int = 0
+    # Prefill at most this many prompt tokens per worker iteration (rounded
+    # up to a whole number of pages); decode steps interleave between
+    # chunks, bounding how long a long prompt can monopolize the worker —
+    # the TTFT-under-load knob. Size it near the typical prompt length:
+    # lower bounds co-tenant TTFT tighter but caps prefill (admission)
+    # throughput at chunk-tokens per iteration — far below the bucket
+    # ceiling it queues prompts faster than it can admit them.
+    serve_prefill_chunk: int = 256
+    # Copy-on-write prefix cache: completed full prompt pages are kept
+    # (refcounted, LRU-evicted under pressure) keyed by a rolling hash of
+    # their tokens, so a shared system prompt is prefilled once and reused.
+    serve_prefix_cache: bool = True
     # --- serving resilience (serving/supervisor.py, serving/router.py) ------
     # Supervisor watchdog: a worker whose heartbeat is older than this many
     # real seconds while work is pending is declared stuck and recovered

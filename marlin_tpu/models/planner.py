@@ -31,7 +31,8 @@ import dataclasses
 import json
 import os
 
-__all__ = ["plan_context", "ContextPlan", "usable_hbm_bytes"]
+__all__ = ["plan_context", "ContextPlan", "usable_hbm_bytes",
+           "kv_page_bytes", "request_pages"]
 
 GIB = 1024 ** 3
 
@@ -59,6 +60,38 @@ def usable_hbm_bytes(total_bytes: int = 16 * GIB,
     except (FileNotFoundError, ValueError):
         pass
     return total_bytes - DEFAULT_RESERVE_BYTES
+
+
+def kv_page_bytes(params: dict, heads: int, page_len: int,
+                  compute_dtype=None) -> int:
+    """Bytes of ONE KV page across every layer: layers x {k,v} x page_len x
+    kv_heads x dh in the compute dtype. The paged serving engine's admission
+    unit — a request is charged :func:`request_pages` x this, the *actual*
+    memory its cache rows can ever pin, instead of the dense-slab era's
+    bucket worst case (docs/serving.md)."""
+    import jax.numpy as jnp
+
+    from .transformer import _n_layers
+
+    d = params["emb"].shape[1]
+    dh = d // heads
+    kv_dim = params["l0"]["wk"].shape[1]  # kv_heads * dh (GQA-aware)
+    dt = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
+    return _n_layers(params) * 2 * page_len * (kv_dim // dh) * dh \
+        * dt.itemsize
+
+
+def request_pages(prompt_len: int, steps: int, page_len: int) -> int:
+    """KV pages one request can ever write: cache positions run
+    ``[0, prompt_len + steps - 1)`` (the final emitted token is never
+    decoded from, so its K/V is never stored), rounded up to whole pages.
+    This is the paged admission charge AND the allocation size — charging
+    what will be written is what guarantees page allocation can never fail
+    under an admission-bounded load (serving/kvpool.py)."""
+    if prompt_len < 1 or steps < 1 or page_len < 1:
+        raise ValueError(f"prompt_len/steps/page_len must be >= 1, got "
+                         f"{(prompt_len, steps, page_len)}")
+    return -(-(prompt_len + steps - 1) // page_len)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -27,7 +27,8 @@ import numpy as np
 __all__ = ["TransformerLM", "init_transformer", "transformer_forward",
            "lm_loss", "lm_train_step", "lm_generate", "lm_generate_batch",
            "init_kv_slab", "lm_prefill_slot", "lm_decode_rows",
-           "synthetic_stream"]
+           "init_kv_pages", "lm_prefill_paged", "lm_decode_paged",
+           "kv_page_copy", "synthetic_stream"]
 
 
 def synthetic_stream(seq: int, vocab: int = 64, seed: int = 0,
@@ -709,17 +710,18 @@ def _lm_generate_batch_jit(params, prompts, lengths, key, heads: int,
 
 
 # --------------------------------------------------------------------------
-# Row-level serving: a persistent slot-resident KV slab + two small programs
-# (slot-targeted prefill, batched single-token decode) that the serving
-# engine's step scheduler composes. Unlike the fused lm_generate_batch (the
-# gang-scheduled serving shape, one program runs a batch to completion), the
-# slab lives on device ACROSS steps — rows enter via prefill into a free
-# slot and leave individually, so batch composition can change every step.
-# Greedy decode is composition-independent (each vmapped row is the same
-# math as lm_generate's), which is what makes per-row results bit-identical
-# to lm_generate on the same prompt; sampled rows draw a per-row stream
-# fold_in(key(seed), step) that is ALSO composition-independent — stronger
-# replay than the gang path's shared-batch key.
+# Row-level serving, dense-slab backend: a persistent slot-resident KV slab
+# + two small programs (slot-targeted prefill, batched single-token decode)
+# that the serving engine's step scheduler composes. Unlike the fused
+# lm_generate_batch (one program runs a batch to completion — the
+# batch-of-prompts eval shape), the slab lives on device ACROSS steps —
+# rows enter via prefill into a free slot and leave individually, so batch
+# composition can change every step. Greedy decode is composition-
+# independent (each vmapped row is the same math as lm_generate's), which
+# is what makes per-row results bit-identical to lm_generate on the same
+# prompt; sampled rows draw a per-row stream fold_in(key(seed), step) that
+# is ALSO composition-independent, so a sampled output replays from
+# (seed, prompt) alone. The paged backend below shares both guarantees.
 
 
 def init_kv_slab(params, rows: int, max_len: int, heads: int,
@@ -878,13 +880,293 @@ def _lm_decode_rows_jit(params, caches, tokens, positions, steps_done, seeds,
     return caches, tokens, nxt
 
 
+# --------------------------------------------------------------------------
+# Paged serving: the KV pool is a single device-resident page slab
+# (num_pages, page_len, kv_heads, dh) per layer shared by EVERY bucket, and
+# a row's cache is a host-side *block table* of page ids covering positions
+# [0, W*page_len). Three programs compose it (serving/kvpool.py owns the
+# host side — free lists, refcounts, copy-on-write prefix sharing):
+#
+#   lm_prefill_paged  one bounded CHUNK of a prompt (C tokens, C a multiple
+#                     of page_len, chunk_start page-aligned): gathers the
+#                     row's prefix context by block table, attends the chunk
+#                     causally against it, and scatters the chunk's K/V into
+#                     the C/page_len pages it covers. Resumable — a long
+#                     prompt prefills across worker iterations, bounding how
+#                     long any one iteration is away from decode.
+#   lm_decode_paged   one token for every row of a bucket: per-row block-
+#                     table gather of the paged context, the SAME
+#                     _decode_step math as the dense-slab scheduler (greedy
+#                     stays bit-identical to lm_generate), and a scatter of
+#                     the one page each row wrote.
+#   kv_page_copy      dst <- src for one page across all layers — the
+#                     copy-on-write half of prefix sharing.
+#
+# Page 0 is the sacrificial dummy: block-table entries beyond a row's
+# allocation (and whole tables of free/prefilling rows during decode) point
+# at it, so out-of-extent gathers read garbage that masking discards and
+# out-of-extent scatters scribble where nothing valid ever lives.
+
+
+def init_kv_pages(params, num_pages: int, page_len: int, heads: int,
+                  compute_dtype: str | None = None):
+    """Zeroed page slab: layer -> (k, v), each (num_pages, page_len,
+    kv_heads, dh) in the compute dtype. One slab per engine — buckets share
+    it; only block tables are bucket-shaped. Keep ``page_len`` a multiple
+    of 8 (16 default) so pages stay sublane-aligned on TPU and the decode
+    gather stays on the fast path (PAPERS.md 2202.05868: block geometry
+    must track the MXU/lane grid)."""
+    if num_pages < 2:
+        raise ValueError(f"num_pages must be >= 2 (page 0 is the dummy), "
+                         f"got {num_pages}")
+    if page_len < 1:
+        raise ValueError(f"page_len must be >= 1, got {page_len}")
+    d = params["emb"].shape[1]
+    dh = d // heads
+    kvh = params["l0"]["wk"].shape[1] // dh  # kv_heads <= heads under GQA
+    dt = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
+    return {f"l{i}": tuple(jnp.zeros((num_pages, page_len, kvh, dh), dt)
+                           for _ in range(2))
+            for i in range(_n_layers(params))}
+
+
+def lm_prefill_paged(params, pages, table, chunk, chunk_start, length,
+                     heads: int, page_len: int, seed=0, temperature=0.0,
+                     top_p=None, top_k=None,
+                     compute_dtype: str | None = None,
+                     moe: tuple | None = None):
+    """One chunk of a paged prefill.
+
+    ``pages`` is the pool slab (:func:`init_kv_pages`) — DONATED, replace
+    your reference with the returned dict. ``table`` is this row's block
+    table, (W_t,) int32 page ids covering positions ``[0, W_t*page_len)``
+    in order (pad unallocated tail entries with the dummy page 0);
+    ``chunk`` is (C,) int32 prompt tokens starting at absolute position
+    ``chunk_start`` (pad past the prompt with zeros). STATIC contract the
+    caller must honor: ``C % page_len == 0`` and ``chunk_start`` a multiple
+    of ``page_len`` (the chunk then covers exactly ``C/page_len`` block-
+    table slots — the scatter is page-exact and never touches a shared
+    prefix page), and ``chunk_start/page_len + C/page_len <= W_t``.
+
+    The chunk attends causally over the gathered prefix (pages written by
+    earlier chunks — or by ANOTHER request, the copy-on-write prefix-share
+    read path) plus itself, writes its K/V pages through the block table,
+    and returns ``(pages, first)`` where ``first`` is the sampled first
+    token — meaningful only on the final chunk (the one containing position
+    ``length - 1``); earlier chunks return a garbage sample the scheduler
+    ignores. One compile per (C, W_t) shape — ``chunk_start``, ``length``,
+    the table, and every sampling knob are traced."""
+    return _lm_prefill_paged_jit(
+        params, pages, jnp.asarray(table, jnp.int32),
+        jnp.asarray(chunk, jnp.int32), jnp.asarray(chunk_start, jnp.int32),
+        jnp.asarray(length, jnp.int32), jnp.asarray(seed, jnp.uint32),
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+        jnp.asarray(0 if top_k is None else top_k, jnp.int32),
+        heads=heads, page_len=page_len, compute_dtype=compute_dtype, moe=moe)
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "page_len",
+                                             "compute_dtype", "moe"),
+                   donate_argnums=(1,))
+def _lm_prefill_paged_jit(params, pages, table, chunk, chunk_start, length,
+                          seed, temperature, top_p, top_k, heads: int,
+                          page_len: int, compute_dtype, moe=None):
+    C = chunk.shape[0]
+    if C % page_len:
+        raise ValueError(f"chunk width {C} must be a multiple of "
+                         f"page_len {page_len}")
+    cp = C // page_len
+    Wt = table.shape[0]
+    L = Wt * page_len
+    cdtype = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
+    d = params["emb"].shape[1]
+    dh = d // heads
+    scale = 1.0 / math.sqrt(dh)  # multiply, exactly as _prefill_attn
+    x = params["emb"][chunk].astype(cdtype)
+    s_page = chunk_start // page_len
+    cols = jnp.arange(C)
+    tpos = jnp.arange(L)
+    # gather EVERY layer's context up front and scatter every layer's new
+    # pages at the END (not interleaved with the per-layer math), with an
+    # optimization barrier pinning the gathers' output layout: without it
+    # the attention einsum's preferred operand layout propagates THROUGH
+    # the gather to the slab parameter and XLA relayouts (copies) every
+    # (num_pages, ...) buffer per call — a cost scaling with the POOL, not
+    # the chunk (measured ~2.5x per chunk on the bench pool; the barrier
+    # moves the transpose onto the small gathered context instead)
+    ctx = jax.lax.optimization_barrier(
+        {name: tuple(t[table].reshape(L, t.shape[2], dh) for t in kv)
+         for name, kv in pages.items()})
+    new_kv = {}
+    for i in range(_n_layers(params)):
+        lp = params[f"l{i}"]
+        kvh = lp["wk"].shape[1] // dh
+        h = _rmsnorm(x, lp["ln1"])
+        q = (h @ lp["wq"].astype(cdtype)).reshape(C, heads, dh)
+        k = (h @ lp["wk"].astype(cdtype)).reshape(C, kvh, dh)
+        v = (h @ lp["wv"].astype(cdtype)).reshape(C, kvh, dh)
+        # splice the chunk's own K/V into the gathered context at its
+        # absolute position (page-aligned, so the update never clamps);
+        # positions past the causal frontier hold stale/garbage pages and
+        # are masked below
+        ctx_k, ctx_v = ctx[f"l{i}"]
+        ctx_k = jax.lax.dynamic_update_slice(
+            ctx_k, k.astype(ctx_k.dtype), (chunk_start, 0, 0))
+        ctx_v = jax.lax.dynamic_update_slice(
+            ctx_v, v.astype(ctx_v.dtype), (chunk_start, 0, 0))
+        kk, vv = ctx_k, ctx_v
+        if kvh != heads:  # GQA: broadcast to query heads, as in _block
+            kk, vv = (jnp.repeat(t, heads // kvh, axis=1) for t in (kk, vv))
+        s = jnp.einsum("phd,thd->hpt", q, kk,
+                       preferred_element_type=jnp.float32) * scale
+        live = tpos[None, None, :] <= (chunk_start + cols)[None, :, None]
+        s = jnp.where(live, s, -1e30)
+        o = jnp.einsum("hpt,thd->phd",
+                       jax.nn.softmax(s, axis=-1).astype(cdtype), vv)
+        x = x + o.reshape(C, d) @ lp["wo"].astype(cdtype)
+        h = _rmsnorm(x, lp["ln2"])
+        if "moe" in lp:
+            from .moe import moe_ffn
+
+            tk, cf, gs = moe if moe is not None else _MOE_DEFAULTS
+            mo, _ = moe_ffn(lp["moe"], h, mesh=None, top_k=tk,
+                            capacity_factor=cf, group_size=gs)
+            x = x + mo
+        else:
+            x = x + (jax.nn.gelu(h @ lp["w1"].astype(cdtype))
+                     @ lp["w2"].astype(cdtype))
+        new_kv[f"l{i}"] = (k, v)
+    # scatter the chunk's pages back: exactly the cp table slots the chunk
+    # covers — a shared prefix page (always before chunk_start) is never
+    # written, which is what makes read-sharing safe. The write is an
+    # UNROLLED chain of single-page dynamic updates rather than one
+    # vector-index scatter: XLA CPU expands the scatter form into a while
+    # loop whose slab-sized carry COPIES the pool every chunk (a cost
+    # scaling with the pool, not the chunk — measured ~2.5x per chunk on
+    # the bench pool), while the DUS chain updates the donated slab in
+    # place. cp is small and static, so the unroll is a handful of ops.
+    new_pages = {}
+    for name, (pk, pv) in pages.items():
+        k, v = new_kv[name]
+        kvh = pk.shape[2]
+        pgk = k.astype(pk.dtype).reshape(cp, page_len, kvh, dh)
+        pgv = v.astype(pv.dtype).reshape(cp, page_len, kvh, dh)
+        for j in range(cp):
+            pid = table[s_page + j]
+            pk = jax.lax.dynamic_update_index_in_dim(pk, pgk[j], pid, 0)
+            pv = jax.lax.dynamic_update_index_in_dim(pv, pgv[j], pid, 0)
+        new_pages[name] = (pk, pv)
+    xf = _rmsnorm(x, params["ln_f"])
+    idx = jnp.clip(length - 1 - chunk_start, 0, C - 1)
+    logits = _head_logits(xf[idx], params["emb"])
+    first = _pick_token_row(temperature, top_p, top_k, logits,
+                            _row_key(seed, 0))
+    return new_pages, first
+
+
+def lm_decode_paged(params, pages, tables, positions, cur_tokens,
+                    steps_done, seeds, temperature, top_p, top_k,
+                    heads: int, page_len: int,
+                    compute_dtype: str | None = None,
+                    moe: tuple | None = None):
+    """One decode step for every row of a bucket over the paged pool.
+
+    ``pages`` is the pool slab (DONATED). ``tables`` is (B, W) int32 block
+    tables — pass an all-dummy (zero) row for every slot that is free or
+    still prefilling: it computes a masked-harmless step against page 0
+    whose outputs the scheduler ignores, exactly the dense-slab dummy-row
+    contract. ``cur_tokens`` is each row's last emitted token (the engine
+    keeps the token stream host-side; the result is built from it), the
+    remaining per-row vectors are as :func:`lm_decode_rows`. Each row
+    gathers its context by block table, runs the SAME :func:`_decode_step`
+    math as the slab scheduler (greedy rows stay bit-identical to
+    :func:`lm_generate`), and scatters back the single page it wrote.
+    Returns ``(pages, next_tokens)``. One compile per (B, W) bucket
+    shape."""
+    as_i32 = lambda v: jnp.asarray(v, jnp.int32)  # noqa: E731
+    return _lm_decode_paged_jit(
+        params, pages, as_i32(tables), as_i32(positions),
+        as_i32(cur_tokens), as_i32(steps_done),
+        jnp.asarray(seeds, jnp.uint32),
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(top_p, jnp.float32), as_i32(top_k),
+        heads=heads, page_len=page_len, compute_dtype=compute_dtype, moe=moe)
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "page_len",
+                                             "compute_dtype", "moe"),
+                   donate_argnums=(1,))
+def _lm_decode_paged_jit(params, pages, tables, positions, cur_tokens,
+                         steps_done, seeds, temperature, top_p, top_k,
+                         heads: int, page_len: int, compute_dtype,
+                         moe=None):
+    B, W = tables.shape
+    L = W * page_len
+    rows = jnp.arange(B)
+    cdtype = jnp.dtype(compute_dtype) if compute_dtype else params["emb"].dtype
+    # clamp so a mis-set position scribbles inside the gathered extent (its
+    # page write then lands in a page the row owns — or the dummy) instead
+    # of clipping out of bounds
+    pos = jnp.minimum(positions, L - 1)
+    x = params["emb"][cur_tokens].astype(cdtype)
+    # gather each row's context in block-table order: position t of the
+    # gathered view IS absolute position t, so _decode_step's positional
+    # masking applies unchanged — the decode math is literally the slab
+    # scheduler's (bit-identity by construction, not by re-derivation)
+    ctx = {name: tuple(t[tables].reshape(B, L, *t.shape[2:]) for t in kv)
+           for name, kv in pages.items()}
+    logits, new_ctx = jax.vmap(
+        lambda xb, cb, pb: _decode_step(params, xb, cb, pb, heads, moe)
+    )(x, ctx, pos)
+    subs = jax.vmap(_row_key)(seeds, steps_done)
+    nxt = jax.vmap(_pick_token_row)(temperature, top_p, top_k, logits, subs)
+    # scatter back the ONE cache entry each row wrote — sliced at pos out
+    # of the updated per-row context, which lets XLA fold the update-then-
+    # slice into the entry itself instead of materializing a whole updated
+    # context copy per layer. Dummy rows all target page 0 offset 0; their
+    # duplicate scatter is last-writer garbage in a page nothing valid
+    # ever reads.
+    pids = tables[rows, pos // page_len]
+    off = pos % page_len
+    new_pages = {}
+    for name, (pk, pv) in pages.items():
+        ck, cv = new_ctx[name]
+
+        def entry(c, p):
+            return jax.lax.dynamic_index_in_dim(c, p, 0, keepdims=False)
+
+        new_pages[name] = (
+            pk.at[pids, off].set(jax.vmap(entry)(ck, pos).astype(pk.dtype)),
+            pv.at[pids, off].set(jax.vmap(entry)(cv, pos).astype(pv.dtype)))
+    return new_pages, nxt
+
+
+def kv_page_copy(pages, src, dst):
+    """Copy page ``src`` onto page ``dst`` across every layer's K and V —
+    the device half of copy-on-write prefix sharing (``pages`` DONATED;
+    ``src``/``dst`` traced, so every copy shares ONE compiled program per
+    slab shape)."""
+    return _kv_page_copy_jit(pages, jnp.asarray(src, jnp.int32),
+                             jnp.asarray(dst, jnp.int32))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _kv_page_copy_jit(pages, src, dst):
+    return {name: tuple(t.at[dst].set(t[src]) for t in kv)
+            for name, kv in pages.items()}
+
+
 # forward the private jit cache-size probe through the un-jitted shims (the
 # no-recompile tests/benches read it; getattr-guarded everywhere, so its
 # absence on a future JAX merely skips those checks)
 for _pub, _jit in ((lm_generate, _lm_generate_jit),
                    (lm_generate_batch, _lm_generate_batch_jit),
                    (lm_prefill_slot, _lm_prefill_slot_jit),
-                   (lm_decode_rows, _lm_decode_rows_jit)):
+                   (lm_decode_rows, _lm_decode_rows_jit),
+                   (lm_prefill_paged, _lm_prefill_paged_jit),
+                   (lm_decode_paged, _lm_decode_paged_jit),
+                   (kv_page_copy, _kv_page_copy_jit)):
     if hasattr(_jit, "_cache_size"):
         _pub._cache_size = _jit._cache_size
 del _pub, _jit

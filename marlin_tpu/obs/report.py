@@ -12,7 +12,9 @@ profiler UI, no live process:
 - **serving TTFT breakdown** — per-request ``queue_s``/``ttft_s``/``total_s``
   from ``serve``/``result`` records decomposed into queue vs prefill vs
   decode time, the serving latency question ("where did the ms go?") in
-  three lines.
+  three lines; streams carrying ``ev="page"`` records (the paged KV pool)
+  additionally get a paging line — prefix-cache hit rate, peak page
+  occupancy, copy-on-write splits.
 - **program utilization** — ``kind="program"`` records (obs/perf.py): XLA
   cost models (``ev="cost"``) and measured-utilization snapshots
   (``ev="util"``, emitted by engine close / streamed ops / the autotuner)
@@ -157,6 +159,31 @@ def _serving_section(events: list[dict]) -> list[str]:
         if breakers:
             line += f"; breaker: {breakers[-1].get('state', '?')}"
         out.append(line)
+    # paged KV pool ride-along (only when the stream carries ev="page"
+    # records, so pre-paging logs render unchanged): prefix-cache hit rate
+    # over alloc records and page occupancy over every pool snapshot
+    pages = [r for r in serve if r.get("ev") == "page"]
+    if pages:
+        allocs = [r for r in pages if r.get("action") == "alloc"]
+        hits = sum(1 for r in allocs if r.get("shared", 0) > 0)
+        shared = sum(r.get("shared", 0) for r in allocs)
+        snaps = [(r["used"], r["total"]) for r in pages
+                 if isinstance(r.get("used"), int)
+                 and isinstance(r.get("total"), int) and r["total"] > 0]
+        line = "paging:"
+        if allocs:
+            line += (f" prefix cache {hits}/{len(allocs)} admissions hit "
+                     f"({hits / len(allocs) * 100:.1f}% — {shared} page(s) "
+                     f"reused instead of re-prefilled);")
+        if snaps:
+            pk_used, pk_total = max(snaps, key=lambda s: s[0] / s[1])
+            line += (f" page occupancy peak "
+                     f"{pk_used / pk_total * 100:.1f}% "
+                     f"({pk_used}/{pk_total} pages)")
+        cows = sum(1 for r in pages if r.get("action") == "cow")
+        if cows:
+            line += f"; {cows} copy-on-write split(s)"
+        out.append(line.rstrip(";"))
     ok = [r for r in results if r.get("status") == "ok"
           and isinstance(r.get("total_s"), (int, float))]
     if ok:

@@ -3,8 +3,10 @@
 The ROADMAP north star is "heavy traffic from millions of users"; the
 reference delegated all request scheduling to Spark (SURVEY.md §0). This
 package is the TPU-native replacement front half: admission control
-(request.py), shape bucketing + dynamic batch formation (batcher.py), the
-worker-loop engine with a drain-safe lifecycle (engine.py), serving
+(request.py), shape bucketing + per-bucket claim queues (batcher.py), the
+paged KV-cache pool with copy-on-write prefix sharing (kvpool.py), the
+worker-loop engine with chunked prefill and a drain-safe lifecycle
+(engine.py), serving
 observability through the EventLog (metrics.py), supervised worker
 recovery with a restart circuit breaker (supervisor.py), and a
 multi-replica router with failover and drain-safe rolling restarts
@@ -30,6 +32,12 @@ from .batcher import (  # noqa: F401
     warmup_buckets,
 )
 from .engine import ServeEngine  # noqa: F401
+from .kvpool import (  # noqa: F401
+    PagedGroup,
+    PagedKVPool,
+    PagePoolExhausted,
+    auto_num_pages,
+)
 from .metrics import ServeMetrics, percentile  # noqa: F401
 from .router import Router  # noqa: F401
 from .supervisor import Supervisor  # noqa: F401

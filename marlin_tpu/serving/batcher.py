@@ -1,46 +1,45 @@
-"""Shape bucketing and dynamic batch formation for the serving engine.
+"""Shape bucketing and per-bucket claim queues for the serving engine.
 
-``_lm_generate_batch_jit`` compiles one XLA program per *shape* — batch B,
-padded prompt P, decode steps S are all baked into the executable. Serving
-traffic is ragged, so without discipline every new (B, P, S) triple pays a
-fresh multi-second compile. The discipline here:
+The serving programs compile one XLA executable per *shape* — slot width B,
+padded prompt P, decode steps S are all baked in. Serving traffic is
+ragged, so without discipline every new shape pays a fresh multi-second
+compile. The discipline here:
 
 - **Buckets** — a small static set of ``(P_bucket, steps_bucket)`` pairs. A
-  request pads its prompt up to the smallest fitting ``P_bucket`` and rounds
-  its steps up to that bucket's ``steps_bucket`` (the result is sliced back
-  to the requested length).
-- **Fixed batch width** — every dispatched batch is padded to exactly
-  ``max_batch`` rows (free rows carry an inert 1-token dummy prompt), so B
-  never varies and the compile count is bounded by the bucket count, not the
-  traffic pattern.
-- **Dynamic forming** — :class:`BatchFormer` groups admitted requests by
-  (bucket, sampling knobs) and closes a group's batch when it reaches
-  ``max_batch`` rows or its oldest request has waited ``max_wait`` seconds,
-  whichever first. The clock is injectable, so tests drive the wait logic
-  deterministically.
-- **Warmup** — :func:`warmup_buckets` runs one dummy full-width batch per
-  bucket so the per-bucket compile happens before traffic (the engine
-  exposes it as ``ServeEngine.warmup()``); :func:`aot_compile_buckets`
-  compiles the same programs against a compile-only TPU topology
-  (:mod:`marlin_tpu.utils.aot` — no chip needed) and returns the compiler's
-  per-bucket peak-HBM accounting, the offline sizing channel for
-  ``serve_buckets`` / ``serve_max_batch``.
+  request pads its prompt up to the smallest fitting ``P_bucket``; rows
+  retire at their *requested* steps (the bucket only sizes the cache
+  extent).
+- **Fixed slot width** — every bucket's row set is exactly ``max_batch``
+  wide (free rows run masked-harmless dummies), so B never varies and the
+  compile count is bounded by the bucket set, not the traffic pattern.
+- **Claim queues** — :class:`BatchFormer` keeps one priority-ordered FIFO
+  per bucket; :meth:`BatchFormer.take_for_bucket` hands freed rows the best
+  pending request immediately (prefill-on-admit — higher ``priority``
+  first, FIFO among equals; sampling knobs never partition anything, they
+  are per-row traced vectors in the decode programs). The gang scheduler's
+  batch-forming machinery (sampling-knob grouping, ``max_wait`` ripening,
+  ``next_batch``) was retired with it in PR 8 — paging superseded the gang
+  fallback.
+- **Warmup** — :func:`warmup_buckets` compiles the slab scheduler's
+  prefill/decode-step pair per bucket before traffic (paged engines warm
+  through :func:`~.kvpool.warmup_paged` instead — the engine's
+  ``warmup()`` picks); :func:`aot_compile_buckets` compiles the same
+  programs against a compile-only TPU topology (:mod:`marlin_tpu.utils
+  .aot` — no chip needed) and returns the compiler's per-bucket peak-HBM
+  accounting, the offline sizing channel for ``serve_buckets`` /
+  ``serve_max_batch`` (paged pools size by page arithmetic instead:
+  ``models/planner.kv_page_bytes`` × ``serve_num_pages``).
 
-Row-level mode (``serve_rowlevel``, the default) keeps the buckets and the
-admission cost model but swaps the dispatch unit: :class:`SlotPool` tracks a
-persistent device-resident KV slab of ``max_batch`` slots per bucket,
-:meth:`BatchFormer.take_for_bucket` hands freed slots the best pending
-request immediately (prefill-on-admit — no ``max_wait`` ripening, no
-sampling-knob grouping: the decode-step program takes per-row traced
-knobs), and warmup/AOT compile exactly TWO programs per bucket (slot
-prefill + single-token decode step).
+:class:`SlotPool` tracks the dense-slab backend's per-bucket state
+(``serve_paged=False``): a persistent device-resident KV slab of
+``max_batch`` slots plus the per-row vectors its decode program takes. The
+paged backend's analog lives in :mod:`.kvpool` (:class:`~.kvpool
+.PagedGroup`).
 """
 
 from __future__ import annotations
 
 import collections
-import heapq
-import itertools
 from typing import Iterable, Sequence
 
 import numpy as np
@@ -105,9 +104,9 @@ def bucket_kv_bytes(params: dict, heads: int, bucket: Bucket,
 
 
 class _Group:
-    """One (bucket, sampling-signature) stream of pending entries, kept in
-    dispatch order: higher priority first, FIFO among equals (stable sort on
-    a monotonic sequence number keeps arrival order)."""
+    """One bucket's stream of pending entries, kept in dispatch order:
+    higher priority first, FIFO among equals (stable sort on a monotonic
+    sequence number keeps arrival order)."""
 
     def __init__(self):
         self.entries: list = []  # (-priority, seq, entry)
@@ -116,11 +115,6 @@ class _Group:
         self.entries.append((-entry.request.priority, seq, entry))
         self.entries.sort(key=lambda t: t[:2])
 
-    def oldest_t(self) -> float:
-        """Earliest enqueue time among pending entries (groups are at most
-        ~max_batch long, so the scan is trivial)."""
-        return min(e.enq_t for _, _, e in self.entries)
-
     def take(self, n: int):
         taken = [e for _, _, e in self.entries[:n]]
         del self.entries[:n]
@@ -128,64 +122,34 @@ class _Group:
 
 
 class BatchFormer:
-    """Groups pending entries by (bucket, temperature, top_p, top_k) and
-    decides when a batch closes. Not thread-safe by itself — the engine calls
-    it under its own condition lock (one mutator, one reader)."""
+    """One priority-ordered claim queue per bucket. Sampling knobs never
+    partition anything — they are per-row traced vectors in the decode
+    programs, so ANY mix shares a step (the gang scheduler's sampling-knob
+    grouping and ``max_wait`` ripening retired with it, PR 8; ``max_wait``
+    is still accepted and ignored so old call sites don't break). Not
+    thread-safe by itself — the engine calls it under its own condition
+    lock (one mutator, one reader)."""
 
     def __init__(self, buckets: Sequence[Bucket], max_batch: int,
-                 max_wait: float):
+                 max_wait: float = 0.0):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait < 0:
             raise ValueError(f"max_wait must be >= 0, got {max_wait}")
         self.buckets = normalize_buckets(buckets)
         self.max_batch = max_batch
-        self.max_wait = max_wait
-        self._groups: dict[tuple, _Group] = collections.defaultdict(_Group)
+        self.max_wait = max_wait  # legacy knob: nothing ripens anymore
+        self._groups: dict[Bucket, _Group] = collections.defaultdict(_Group)
         self._seq = 0
 
     def add(self, entry) -> None:
-        """File one admitted entry under its (bucket, sampling) group.
-        ``entry.bucket`` and ``entry.enq_t`` were set at admission
-        (engine.submit). Sampled requests (temperature > 0) additionally
-        group by seed — the whole batch decodes under ONE PRNG key, so a
-        different-seed co-tenant would silently get its neighbor's stream;
-        greedy requests ignore the key, so seed never fragments their
-        batches."""
-        r = entry.request
-        seed = r.seed if r.temperature > 0 else None
-        key = (entry.bucket, float(r.temperature), r.top_p, r.top_k, seed)
-        self._groups[key].add(entry, self._seq)
+        """File one admitted entry under its bucket. ``entry.bucket`` and
+        ``entry.enq_t`` were set at admission (engine.submit)."""
+        self._groups[entry.bucket].add(entry, self._seq)
         self._seq += 1
 
     def pending(self) -> int:
         return sum(len(g.entries) for g in self._groups.values())
-
-    def next_batch(self, now: float, force: bool = False):
-        """``(group_key, entries)`` for the batch to dispatch now, else
-        ``(None, wait_hint)`` — ``wait_hint`` the seconds (on the injected
-        clock) until the oldest partial batch hits ``max_wait`` (``None``
-        when nothing is pending). Full groups dispatch immediately; among
-        ripe partial groups the longest-waiting dispatches first. ``force``
-        treats every non-empty group as ripe — the drain path, where waiting
-        out ``max_wait`` for stragglers that can never arrive is pointless."""
-        ripe, ripe_t, hint = None, None, None
-        for key, g in self._groups.items():
-            if not g.entries:
-                continue
-            if len(g.entries) >= self.max_batch:
-                return key, g.take(self.max_batch)
-            oldest = g.oldest_t()
-            waited = now - oldest
-            if force or waited >= self.max_wait:
-                if ripe is None or oldest < ripe_t:
-                    ripe, ripe_t = key, oldest
-            else:
-                left = self.max_wait - waited
-                hint = left if hint is None else min(hint, left)
-        if ripe is not None:
-            return ripe, self._groups[ripe].take(self.max_batch)
-        return None, hint
 
     def take_all(self) -> list:
         """Drain every pending entry (close() path — they get ShuttingDown
@@ -195,33 +159,16 @@ class BatchFormer:
             out.extend(g.take(len(g.entries)))
         return out
 
-    # ---- row-level claiming (serve_rowlevel): slots admit individually, so
-    # the gang machinery above (sampling-knob grouping, max_wait ripening)
-    # does not apply — the decode-step program takes per-row traced sampling
-    # knobs and every row draws its own stream, so ANY mix shares a step.
-
     def pending_buckets(self) -> set:
-        """Buckets that currently have pending entries (row-level scheduler:
-        which slot pools might claim work this iteration)."""
-        return {key[0] for key, g in self._groups.items() if g.entries}
+        """Buckets that currently have pending entries (which groups might
+        claim work this iteration)."""
+        return {b for b, g in self._groups.items() if g.entries}
 
     def take_for_bucket(self, bucket: Bucket, n: int) -> list:
-        """Up to ``n`` entries bound for ``bucket``, merged across every
-        sampling group in dispatch order (higher priority first, FIFO among
-        equals) — the prefill-on-admit path: a freed slot takes the best
-        pending request immediately, no max_wait ripening. Each group's list
-        is already sorted by its (-priority, seq) tuples (``_Group.add``),
-        so a k-way heap merge preserves that one ordering rule instead of
-        duplicating the comparator here; ``seq`` is globally unique, so the
-        tuple comparison never reaches the entry itself."""
-        groups = [g for key, g in self._groups.items()
-                  if key[0] == bucket and g.entries]
-        taken = list(itertools.islice(
-            heapq.merge(*(g.entries for g in groups)), n))
-        take_ids = {id(t) for t in taken}
-        for g in groups:
-            g.entries = [t for t in g.entries if id(t) not in take_ids]
-        return [e for _, _, e in taken]
+        """Up to ``n`` entries bound for ``bucket`` in dispatch order —
+        the prefill-on-admit path: a freed row takes the best pending
+        request immediately."""
+        return self._groups[bucket].take(n) if bucket in self._groups else []
 
 
 class SlotPool:
@@ -336,35 +283,34 @@ def capture_bucket_costs(params: dict, heads: int, bucket: Bucket,
                          rowlevel: bool | None = None,
                          key: str | None = None) -> None:
     """Capture the XLA cost model (flops, bytes accessed) of a bucket's
-    compiled program(s) into the process :class:`~marlin_tpu.obs.perf
+    slab program pair into the process :class:`~marlin_tpu.obs.perf
     .ProgramCosts` registry — trace + lower only (no backend compile; the
     bucket's real compile already happened or is about to through the jit
     cache). Gated per (program, bucket key) so repeated calls — the engine
-    invokes this on every pool creation and gang dispatch — cost two dict
-    lookups after the first. Callers on the dispatch path pass their cached
-    ``key`` (the engine's ``_prog_key``) so the gate really is that cheap —
-    rebuilding it walks the params tree. Never raises: cost capture is
-    observability and must not fail warmup or a dispatch."""
+    invokes this on every pool creation — cost two dict lookups after the
+    first. Callers on the dispatch path pass their cached ``key`` (the
+    engine's ``_prog_key``) so the gate really is that cheap — rebuilding
+    it walks the params tree. Never raises: cost capture is observability
+    and must not fail warmup or a dispatch. ``rowlevel`` is vestigial
+    (accepted, ignored — the gang program this captured when False is
+    retired); the paged pair captures through
+    :func:`~.kvpool.capture_paged_costs`."""
     import jax
 
-    from ..config import get_config
     from ..obs import perf
 
-    if rowlevel is None:
-        rowlevel = get_config().serve_rowlevel
+    del rowlevel  # retired with the gang scheduler (PR 8)
     costs = perf.get_program_costs()
     if key is None:
         key = bucket_program_key(params, bucket, max_batch, compute_dtype)
-    programs = (("lm_prefill_slot", "lm_decode_rows") if rowlevel
-                else ("lm_generate_batch",))
+    programs = ("lm_prefill_slot", "lm_decode_rows")
     # gate on attempted, not succeeded: a backend without cost_analysis()
-    # must not re-pay this trace+lower on every gang dispatch
+    # must not re-pay this trace+lower on every dispatch
     if all(costs.tried(name, key) for name in programs):
         return
     import jax.numpy as jnp
 
     from ..models.transformer import (_lm_decode_rows_jit,
-                                      _lm_generate_batch_jit,
                                       _lm_prefill_slot_jit, init_kv_slab)
 
     def st(shape, dtype=jnp.int32):
@@ -374,34 +320,24 @@ def capture_bucket_costs(params: dict, heads: int, bucket: Bucket,
         lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype), tree)
     p, s = bucket
     try:
-        if rowlevel:
-            caches = sds(jax.eval_shape(
-                lambda pp: init_kv_slab(pp, max_batch, p + s, heads,
-                                        compute_dtype), params))
-            tokens = st((max_batch, p + s))
-            pre = _lm_prefill_slot_jit.trace(
-                sds(params), caches, tokens, st(()), st((p,)), st(()),
-                st((), jnp.uint32), st((), jnp.float32),
-                st((), jnp.float32), st(()), heads=heads, max_len=p + s,
-                compute_dtype=compute_dtype, moe=moe).lower()
-            dec = _lm_decode_rows_jit.trace(
-                sds(params), caches, tokens, st((max_batch,)),
-                st((max_batch,)), st((max_batch,), jnp.uint32),
-                st((max_batch,), jnp.float32),
-                st((max_batch,), jnp.float32), st((max_batch,)),
-                heads=heads, max_len=p + s, compute_dtype=compute_dtype,
-                moe=moe).lower()
-            costs.capture("lm_prefill_slot", key, lowered=pre)
-            costs.capture("lm_decode_rows", key, lowered=dec)
-        else:
-            lo = _lm_generate_batch_jit.trace(
-                sds(params), st((max_batch, p)), st((max_batch,)),
-                sds(jax.eval_shape(jax.random.key, 0)),
-                heads=heads, max_len=p + s, steps=s,
-                temperature=st((), jnp.float32),
-                compute_dtype=compute_dtype, top_p=st((), jnp.float32),
-                use_top_p=False, top_k=None, moe=moe).lower()
-            costs.capture("lm_generate_batch", key, lowered=lo)
+        caches = sds(jax.eval_shape(
+            lambda pp: init_kv_slab(pp, max_batch, p + s, heads,
+                                    compute_dtype), params))
+        tokens = st((max_batch, p + s))
+        pre = _lm_prefill_slot_jit.trace(
+            sds(params), caches, tokens, st(()), st((p,)), st(()),
+            st((), jnp.uint32), st((), jnp.float32),
+            st((), jnp.float32), st(()), heads=heads, max_len=p + s,
+            compute_dtype=compute_dtype, moe=moe).lower()
+        dec = _lm_decode_rows_jit.trace(
+            sds(params), caches, tokens, st((max_batch,)),
+            st((max_batch,)), st((max_batch,), jnp.uint32),
+            st((max_batch,), jnp.float32),
+            st((max_batch,), jnp.float32), st((max_batch,)),
+            heads=heads, max_len=p + s, compute_dtype=compute_dtype,
+            moe=moe).lower()
+        costs.capture("lm_prefill_slot", key, lowered=pre)
+        costs.capture("lm_decode_rows", key, lowered=dec)
     except Exception:
         # even a failed trace marks the attempt — never retry per dispatch
         for name in programs:
@@ -412,50 +348,38 @@ def warmup_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
                    max_batch: int, compute_dtype: str | None = None,
                    moe: tuple | None = None,
                    rowlevel: bool | None = None) -> int:
-    """Compile (and execute once, on dummy rows) every bucket's programs, so
-    the first real request never pays the compile. ``rowlevel`` defaults
-    from ``config.serve_rowlevel``, matching what an all-default engine
-    runs: gang mode warms the one fused full-width batch program per
-    bucket; row-level warms the TWO programs per bucket — slot-targeted
-    prefill and the single-token decode step over a throwaway slab.
-    Returns the number of buckets warmed. Greedy/default-sampling programs
-    in gang mode (a float top_p or a top_k adds its own variant on first
-    use); row-level sampling knobs are per-row traced, so the two programs
-    are the whole compile story (docs/serving.md)."""
+    """Compile (and execute once, on dummy rows) every bucket's dense-slab
+    program pair — slot-targeted prefill and the single-token decode step
+    over a throwaway slab — so the first real request never pays the
+    compile. Sampling knobs are per-row traced, so the two programs are
+    the whole slab compile story (docs/serving.md); paged engines warm
+    through :func:`~.kvpool.warmup_paged` against their live pool instead.
+    ``rowlevel`` is vestigial (accepted, ignored — the gang program it
+    used to warm when False is retired). Returns the buckets warmed."""
     import jax
 
-    from ..config import get_config
-    from ..models.transformer import lm_generate_batch
+    from ..models.transformer import lm_decode_rows, lm_prefill_slot
 
-    if rowlevel is None:
-        rowlevel = get_config().serve_rowlevel
+    del rowlevel  # retired with the gang scheduler (PR 8)
     buckets = normalize_buckets(buckets)
     for bucket in buckets:
         p, s = bucket
-        prompts, lengths = _dummy_batch(bucket, max_batch)
+        prompts, _ = _dummy_batch(bucket, max_batch)
         # roofline accounting: the bucket's XLA cost model lands in the
         # process ProgramCosts registry alongside the warmup compile
         capture_bucket_costs(params, heads, bucket, max_batch,
-                             compute_dtype, moe, rowlevel=rowlevel)
-        if rowlevel:
-            from ..models.transformer import lm_decode_rows, lm_prefill_slot
-
-            pool = SlotPool(params, heads, bucket, max_batch, compute_dtype)
-            caches, tokens, _ = lm_prefill_slot(
-                params, pool.caches, pool.tokens, 0, prompts[0], 1,
-                heads=heads, max_len=p + s, compute_dtype=compute_dtype,
-                moe=moe)
-            caches, tokens, nxt = lm_decode_rows(
-                params, caches, tokens, pool.positions, pool.steps_done,
-                pool.seeds, pool.temperature, pool.top_p, pool.top_k,
-                heads=heads, max_len=p + s, compute_dtype=compute_dtype,
-                moe=moe)
-            jax.block_until_ready(nxt)
-        else:
-            out = lm_generate_batch(
-                params, prompts, lengths, jax.random.key(0), heads=heads,
-                max_len=p + s, steps=s, compute_dtype=compute_dtype, moe=moe)
-            jax.block_until_ready(out)
+                             compute_dtype, moe)
+        pool = SlotPool(params, heads, bucket, max_batch, compute_dtype)
+        caches, tokens, _ = lm_prefill_slot(
+            params, pool.caches, pool.tokens, 0, prompts[0], 1,
+            heads=heads, max_len=p + s, compute_dtype=compute_dtype,
+            moe=moe)
+        caches, tokens, nxt = lm_decode_rows(
+            params, caches, tokens, pool.positions, pool.steps_done,
+            pool.seeds, pool.temperature, pool.top_p, pool.top_k,
+            heads=heads, max_len=p + s, compute_dtype=compute_dtype,
+            moe=moe)
+        jax.block_until_ready(nxt)
     return len(buckets)
 
 
@@ -481,32 +405,31 @@ def aot_compile_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
     ``{bucket: peak_hbm_bytes}`` from the compiler's own accounting — the
     offline evidence for sizing ``serve_buckets`` x ``serve_max_batch``
     against :func:`~marlin_tpu.models.planner.usable_hbm_bytes` (the same
-    budget the admission gate enforces at runtime). ``rowlevel`` defaults
-    from ``config.serve_rowlevel`` — the same scheduler an all-default
-    :class:`~.engine.ServeEngine` will actually run. Gang mode compiles the
-    fused batch program; row-level compiles BOTH programs (slot prefill +
-    decode step) and reports the larger peak. NOTE the row-level sizing
-    rule differs from gang: every bucket's persistent slab stays device-
-    resident simultaneously (the engine never frees a pool), so steady-
-    state HBM is the SUM over buckets of ``bucket_kv_bytes(...,
+    budget the admission gate enforces at runtime). Compiles the dense-slab
+    backend's program pair (slot prefill + decode step) and reports the
+    larger peak; ``rowlevel`` is vestigial (accepted, ignored — the gang
+    program is retired). Sizing rule: every bucket's persistent slab stays
+    device-resident simultaneously (the engine never frees a pool), so
+    steady-state HBM is the SUM over buckets of ``bucket_kv_bytes(...,
     batch=max_batch)`` plus the largest per-bucket program peak reported
-    here — not the largest bucket alone (docs/serving.md, bucket tuning).
-    Requires libtpu (:func:`~marlin_tpu.utils.aot.supports_aot_tpu`). Peak
-    accounting degrades to the temp+argument+output lower bound on PJRT
-    builds whose stats object lacks ``peak_memory_in_bytes``
-    (:func:`_peak_bytes`)."""
+    here — not the largest bucket alone. The paged backend sizes by page
+    arithmetic instead: ``serve_num_pages`` x
+    :func:`~marlin_tpu.models.planner.kv_page_bytes` IS its steady-state
+    cache footprint, whatever the bucket set (docs/serving.md, bucket
+    tuning). Requires libtpu
+    (:func:`~marlin_tpu.utils.aot.supports_aot_tpu`). Peak accounting
+    degrades to the temp+argument+output lower bound on PJRT builds whose
+    stats object lacks ``peak_memory_in_bytes`` (:func:`_peak_bytes`)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
 
-    from ..config import config_context, get_config
+    from ..config import config_context
     from ..models.transformer import (_lm_decode_rows_jit,
-                                      _lm_generate_batch_jit,
                                       _lm_prefill_slot_jit, init_kv_slab)
     from ..utils.aot import topology_mesh
 
-    if rowlevel is None:
-        rowlevel = get_config().serve_rowlevel
+    del rowlevel  # retired with the gang scheduler (PR 8)
     mesh = topology_mesh(("rows",), (1,), topology_name=topology_name)
     rep = NamedSharding(mesh, PartitionSpec())
 
@@ -527,43 +450,30 @@ def aot_compile_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
         prog_key = bucket_program_key(params, bucket, max_batch,
                                       compute_dtype)
         with config_context(pallas_interpret=False):
-            if rowlevel:
-                # derive the slab structs from init_kv_slab itself (the one
-                # source of truth for the layout) instead of re-deriving
-                # d/dh/kvh by hand — a layout change there cannot silently
-                # diverge from what this tool sizes
-                caches = sds(jax.eval_shape(
-                    lambda pp: init_kv_slab(pp, max_batch, p + s, heads,
-                                            compute_dtype), params))
-                tokens = st((max_batch, p + s))
-                pre = _lm_prefill_slot_jit.trace(
-                    sds(params), caches, tokens, st(()), st((p,)), st(()),
-                    st((), jnp.uint32), st((), jnp.float32),
-                    st((), jnp.float32), st(()), heads=heads, max_len=p + s,
-                    compute_dtype=compute_dtype, moe=moe).lower().compile()
-                dec = _lm_decode_rows_jit.trace(
-                    sds(params), caches, tokens, st((max_batch,)),
-                    st((max_batch,)), st((max_batch,), jnp.uint32),
-                    st((max_batch,), jnp.float32),
-                    st((max_batch,), jnp.float32), st((max_batch,)),
-                    heads=heads, max_len=p + s, compute_dtype=compute_dtype,
-                    moe=moe).lower().compile()
-                # the compiled objects carry BOTH analyses — richest
-                # capture the registry gets (memory_analysis included)
-                costs.capture("lm_prefill_slot", prog_key, compiled=pre)
-                costs.capture("lm_decode_rows", prog_key, compiled=dec)
-                out[bucket] = max(_peak_bytes(pre.memory_analysis()),
-                                  _peak_bytes(dec.memory_analysis()))
-            else:
-                args = (sds(params), st((max_batch, p)), st((max_batch,)),
-                        sds(jax.eval_shape(jax.random.key, 0)),
-                        st((), jnp.float32), st((), jnp.float32))
-                compiled = _lm_generate_batch_jit.trace(
-                    *args[:4], heads=heads, max_len=p + s, steps=s,
-                    temperature=args[4], compute_dtype=compute_dtype,
-                    top_p=args[5], use_top_p=False, top_k=None,
-                    moe=moe).lower().compile()
-                costs.capture("lm_generate_batch", prog_key,
-                              compiled=compiled)
-                out[bucket] = _peak_bytes(compiled.memory_analysis())
+            # derive the slab structs from init_kv_slab itself (the one
+            # source of truth for the layout) instead of re-deriving
+            # d/dh/kvh by hand — a layout change there cannot silently
+            # diverge from what this tool sizes
+            caches = sds(jax.eval_shape(
+                lambda pp: init_kv_slab(pp, max_batch, p + s, heads,
+                                        compute_dtype), params))
+            tokens = st((max_batch, p + s))
+            pre = _lm_prefill_slot_jit.trace(
+                sds(params), caches, tokens, st(()), st((p,)), st(()),
+                st((), jnp.uint32), st((), jnp.float32),
+                st((), jnp.float32), st(()), heads=heads, max_len=p + s,
+                compute_dtype=compute_dtype, moe=moe).lower().compile()
+            dec = _lm_decode_rows_jit.trace(
+                sds(params), caches, tokens, st((max_batch,)),
+                st((max_batch,)), st((max_batch,), jnp.uint32),
+                st((max_batch,), jnp.float32),
+                st((max_batch,), jnp.float32), st((max_batch,)),
+                heads=heads, max_len=p + s, compute_dtype=compute_dtype,
+                moe=moe).lower().compile()
+            # the compiled objects carry BOTH analyses — richest
+            # capture the registry gets (memory_analysis included)
+            costs.capture("lm_prefill_slot", prog_key, compiled=pre)
+            costs.capture("lm_decode_rows", prog_key, compiled=dec)
+            out[bucket] = max(_peak_bytes(pre.memory_analysis()),
+                              _peak_bytes(dec.memory_analysis()))
     return out

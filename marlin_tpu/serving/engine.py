@@ -5,56 +5,76 @@ library's compiled decode programs: concurrent callers ``submit`` requests;
 an admission gate (queue depth + in-flight KV-cache HBM budget, request.py)
 rejects overload with a reason; a batch former (batcher.py) buckets prompts
 onto a small static shape set so compiles stay bounded; and a single worker
-thread keeps the device fed. Two schedulers share that skeleton:
+thread keeps the device fed. Scheduling is row-level (the unit is the
+slot-step, not the batch — the gang scheduler of PR 3 is retired; a
+``serve_rowlevel=False`` config earns a DeprecationWarning and changes
+nothing). Two KV-cache backends share the row-level skeleton:
 
-**Row-level** (``serve_rowlevel``, the default) changes the unit of
-scheduling from "batch" to "slot-step". Each bucket owns a persistent
-device-resident KV slab of ``max_batch`` slots (:class:`~.batcher.SlotPool`)
-and TWO compiled programs — slot-targeted prefill
-(:func:`~marlin_tpu.models.transformer.lm_prefill_slot`) and a single-token
-decode step over the whole slab
-(:func:`~marlin_tpu.models.transformer.lm_decode_rows`, donated KV buffers,
-per-row positions and sampling knobs). Every worker iteration:
+**Paged** (``serve_paged``, the default): ONE device-resident page slab
+(:mod:`.kvpool` over :func:`~marlin_tpu.models.transformer.init_kv_pages`)
+shared by every bucket; each row holds a host-side block table of pages.
+Admission charges the request's ACTUAL pages
+(:func:`~marlin_tpu.models.planner.request_pages` — a short request in a
+long bucket no longer reserves the bucket's worst case), completed full
+prompt pages are prefix-shared copy-on-write across requests (a common
+system prompt is prefilled once — :class:`~.kvpool.PagedKVPool`), and long
+prompts prefill in bounded ``serve_prefill_chunk``-token chunks. Every
+worker iteration:
 
-    refill freed slots from the queue (prefill-on-admit; the prompt's
-    first token lands here — real TTFT)  →  retire rows that emitted
-    their ``eos``, hit their step budget, or expired  →  run ONE decode
-    step for all live rows  →  repeat
+    refill freed rows from the queue (page allocation + prefix match —
+    host-side, cheap)  →  prefill at most ``serve_prefill_chunk`` prompt
+    TOKENS, oldest row first, in page-aligned chunks (several short rows
+    may share the budget; a long prompt takes one chunk and resumes next
+    iteration; a row's final chunk emits its first token — real TTFT)
+    →  retire rows that emitted ``eos``, hit their step budget, or
+    expired  →  run ONE decode step per bucket over its live rows  →
+    repeat
 
-A finished row's slot refills on the very next step instead of riding out
-its batch as a dummy, and a newly admitted request waits one step, not one
-whole batch — the tokens/s and TTFT win at high offered load. Per-row
-greedy output stays bit-identical to :func:`~marlin_tpu.models.transformer
-.lm_generate` on the same prompt (greedy decode is composition-independent)
-and the compile count is ≤ 2 programs per bucket, for ANY per-row mix of
-sampling knobs (they are traced vectors).
+so one long prompt can never monopolize an iteration — decode steps
+interleave between its chunks, bounding TTFT for everyone else. ≤ 3
+compiled programs per bucket (chunked prefill + decode step, plus one
+pool-wide page-copy program), for ANY per-row mix of sampling knobs.
 
-**Gang** (``serve_rowlevel=False``, the fallback) runs one fused
-``lm_generate_batch`` program per bucket to completion: all ``max_batch``
-slot rows launch and land together (free slots carry inert dummy rows).
-Simpler — one program per bucket, no per-step host sync — but a finished
-row holds its slot as a dummy until the whole batch lands, and admissions
-wait out the entire in-flight batch.
+**Dense slab** (``serve_paged=False``, the PR 4 control): each bucket owns
+a persistent ``(max_batch, max_len, kvh, dh)`` slab
+(:class:`~.batcher.SlotPool`), whole-prompt prefill on admit
+(:func:`~marlin_tpu.models.transformer.lm_prefill_slot`), decode via
+:func:`~marlin_tpu.models.transformer.lm_decode_rows` — 2 programs per
+bucket, admission charged at the bucket worst case. The paged-vs-slab A/B
+in ``bench_all.py serve`` runs this side.
 
-Lifecycle (both schedulers): ``drain()`` stops admission and completes
-everything already accepted; ``close()`` stops admission, finishes the work
-in flight (the gang batch / the live slots), and retires everything still
-queued with a clean ``shutting_down`` Result. Both are terminal and
-idempotent; the worker thread (named ``marlin-serve-*`` — the conftest leak
-fixture watches the prefix) is joined before either returns. Chaos hooks
-(utils/faults.py): ``serve.enqueue`` fires in ``submit``; ``serve.step``
-fires before each gang batch launch / each row-level prefill — a fault
-fails those requests with ``error`` Results; ``serve.decode_step`` fires
-before each row-level decode step — a fault there fails only that step's
-live rows and leaves the slot pool consistent. The engine keeps serving
-after any of them.
+Both backends keep the invariants PR 3/4 established: exactly one Result
+per request, per-row greedy output bit-identical to
+:func:`~marlin_tpu.models.transformer.lm_generate` on the unpadded prompt
+(the paged decode literally reuses ``_decode_step``), and sampled rows on
+composition-independent ``fold_in(key(seed), step)`` streams.
+
+Lifecycle: ``drain()`` stops admission and completes everything already
+accepted; ``close()`` stops admission, finishes the work in flight (live
+and mid-prefill rows), and retires everything still queued with a clean
+``shutting_down`` Result. Both are terminal and idempotent; the worker
+thread (named ``marlin-serve-*`` — the conftest leak fixture watches the
+prefix) is joined before either returns. Chaos hooks (utils/faults.py):
+``serve.enqueue`` fires in ``submit``; ``serve.step`` fires before each
+slab prefill; ``serve.prefill`` fires before each paged prefill CHUNK — a
+fault fails/retries that one request and the pool stays consistent (the
+chunk cursor makes prefill resumable, so a retry re-runs the prompt from
+its shared prefix); ``serve.decode_step`` fires before each decode step —
+a fault fails/retries only that step's live rows. The engine keeps serving
+after any of them; if a failed donated call consumed the page slab, every
+resident row fails/retries and the pool is rebuilt zeroed — the same
+contract worker-crash recovery gives it (supervisor.py: pools dropped,
+live rows requeued, page-unit admission reservations carried across
+attempts).
 """
 
 from __future__ import annotations
 
+import collections
 import itertools
 import threading
 import time
+import warnings
 import weakref
 
 import numpy as np
@@ -68,6 +88,9 @@ from ..utils import faults
 from .batcher import (BatchFormer, bucket_kv_bytes, bucket_program_key,
                       capture_bucket_costs, normalize_buckets, pick_bucket,
                       warmup_buckets)
+from .kvpool import (PagedGroup, PagedKVPool, PagePoolExhausted,
+                     auto_num_pages, capture_paged_costs, paged_program_key,
+                     warmup_paged)
 from .metrics import ServeMetrics
 from .request import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK,
                       STATUS_REJECTED, STATUS_SHUTTING_DOWN, AdmissionQueue,
@@ -85,9 +108,8 @@ _POLL_CAP_S = 0.02
 
 
 class _Entry:
-    """One admitted request riding through the former to a batch slot.
-    ``queue_s`` is stamped when the row-level scheduler claims the entry
-    for a slot (the gang path derives it at dispatch instead). ``trace``
+    """One admitted request riding through the former to a row.
+    ``queue_s`` is stamped when the scheduler claims the entry. ``trace``
     is the request's span context (obs/trace.py), captured at submit and
     re-activated by the worker thread around every record the request
     produces — that cross-thread handoff is what joins one request's
@@ -142,10 +164,13 @@ class ServeEngine:
     tests; wall throughput is always measured on the real clock. ``log``
     overrides the default EventLog for ``serve`` records.
 
-    ``rowlevel`` picks the scheduler (``serve_rowlevel`` by default): True =
-    slot-step scheduling over persistent per-bucket KV slabs (prefill +
-    decode-step programs, per-row retirement/refill); False = the gang
-    fallback (one fused program per bucket runs a batch to completion).
+    ``paged`` picks the KV backend (``serve_paged`` by default): True = the
+    paged pool (block tables over one shared page slab, prefix caching,
+    chunked prefill; ``page_len``/``num_pages``/``prefill_chunk``/
+    ``prefix_cache`` override the ``serve_*`` knobs); False = the dense
+    per-bucket slot slab (the PR 4 control). ``rowlevel`` is DEPRECATED:
+    the gang scheduler it used to disable is retired — passing False (or
+    configuring ``serve_rowlevel=False``) warns and changes nothing.
 
     Usable as a context manager (``close()`` on exit); ``start=False`` defers
     the worker thread so tests can stage a queue before any dispatch."""
@@ -156,15 +181,24 @@ class ServeEngine:
                  queue_depth: int | None = None,
                  hbm_budget_bytes: int | None = None,
                  compute_dtype: str | None = None, moe: tuple | None = None,
-                 rowlevel: bool | None = None,
+                 rowlevel: bool | None = None, paged: bool | None = None,
+                 page_len: int | None = None, num_pages: int | None = None,
+                 prefill_chunk: int | None = None,
+                 prefix_cache: bool | None = None,
                  clock=time.monotonic, log=None, start: bool = True):
         cfg = get_config()
         self.params = params
         self.heads = heads
         self.compute_dtype = compute_dtype
         self.moe = moe
-        self.rowlevel = bool(cfg.serve_rowlevel if rowlevel is None
-                             else rowlevel)
+        if not (cfg.serve_rowlevel if rowlevel is None else rowlevel):
+            warnings.warn(
+                "serve_rowlevel=False selected the gang scheduler, which "
+                "is retired (PR 8: paging supersedes it) — the engine "
+                "always schedules row-level; use serve_paged/paged to pick "
+                "the KV backend", DeprecationWarning, stacklevel=2)
+        self.rowlevel = True  # legacy attribute: always row-level now
+        self.paged = bool(cfg.serve_paged if paged is None else paged)
         self.buckets = normalize_buckets(
             cfg.serve_buckets if buckets is None else buckets)
         self.max_batch = int(cfg.serve_max_batch if max_batch is None
@@ -172,6 +206,24 @@ class ServeEngine:
         wait_ms = cfg.serve_max_wait_ms if max_wait_ms is None else max_wait_ms
         depth = int(cfg.serve_queue_depth if queue_depth is None
                     else queue_depth)
+        # --- paged-pool geometry (serving/kvpool.py) -----------------------
+        self._page_len = int(cfg.serve_page_len if page_len is None
+                             else page_len)
+        self._prefill_chunk = int(cfg.serve_prefill_chunk
+                                  if prefill_chunk is None else prefill_chunk)
+        self._prefix_cache = bool(cfg.serve_prefix_cache
+                                  if prefix_cache is None else prefix_cache)
+        npages = int(cfg.serve_num_pages if num_pages is None else num_pages)
+        if npages <= 0:
+            npages = auto_num_pages(self.buckets, self.max_batch,
+                                    self._page_len)
+        self._num_pages = npages
+        self._kvpool: PagedKVPool | None = None  # built lazily / on warmup
+        if self.paged:
+            from ..models.planner import kv_page_bytes
+
+            self._page_bytes = kv_page_bytes(params, heads, self._page_len,
+                                             compute_dtype)
         if hbm_budget_bytes is None:
             from ..models.planner import usable_hbm_bytes
 
@@ -193,7 +245,6 @@ class ServeEngine:
         # without touching shared state (its entries are superseded)
         self._gen = 0
         self._pools: dict[tuple, object] = {}   # current worker's slot pools
-        self._inflight: list = []               # current gang batch entries
         self._claimed: list = []                # claimed-but-unslotted rows
         self._crash: tuple | None = None        # (exc, undone entries)
         self._on_crash = None                   # supervisor's prompt-wake cb
@@ -252,12 +303,22 @@ class ServeEngine:
         self._thread.start()
 
     def warmup(self) -> int:
-        """Compile every bucket's program(s) before traffic — the fused
-        batch program per bucket in gang mode, the prefill + decode-step
-        pair per bucket in row-level mode (batcher.warmup_buckets)."""
+        """Compile every bucket's programs before traffic: the chunked
+        prefill + decode pair per bucket plus the shared page-copy program
+        in paged mode (kvpool.warmup_paged, against THIS engine's pool —
+        program identity includes the slab shape), the slot prefill +
+        decode pair in slab mode (batcher.warmup_buckets). Call before the
+        first submit — warmup drives the live pool."""
+        if self.paged:
+            with self._cond:  # never race a worker's lazy pool creation
+                pool = self._ensure_kvpool()
+            return warmup_paged(self.params, self.heads, self.buckets,
+                                self.max_batch, pool,
+                                self._prefill_chunk, self.compute_dtype,
+                                self.moe)
         return warmup_buckets(self.params, self.heads, self.buckets,
                               self.max_batch, self.compute_dtype, self.moe,
-                              rowlevel=self.rowlevel)
+                              rowlevel=True)
 
     def pending(self) -> int:
         """Requests admitted but not yet retired (queued + in flight)."""
@@ -285,12 +346,33 @@ class ServeEngine:
 
     def _prog_key(self, bucket) -> str:
         """The roofline-accounting key for this engine's programs at one
-        bucket (cached — it sits on the per-step path)."""
+        bucket (cached — it sits on the per-step path). Paged programs key
+        the page geometry in too (kvpool.paged_program_key)."""
         key = self._prog_keys.get(bucket)
         if key is None:
-            key = self._prog_keys[bucket] = bucket_program_key(
-                self.params, bucket, self.max_batch, self.compute_dtype)
+            if self.paged:
+                key = paged_program_key(self.params, bucket, self.max_batch,
+                                        self._page_len, self.compute_dtype)
+            else:
+                key = bucket_program_key(self.params, bucket, self.max_batch,
+                                         self.compute_dtype)
+            self._prog_keys[bucket] = key
         return key
+
+    def _ensure_kvpool(self) -> PagedKVPool:
+        """The engine's one paged pool, built lazily (warmup or the first
+        admission) and rebuilt zeroed after a recovery or slab loss."""
+        pool = self._kvpool
+        if pool is None:
+            pool = self._kvpool = PagedKVPool(
+                self.params, self.heads, self._num_pages, self._page_len,
+                self.compute_dtype, self._prefix_cache)
+            self.metrics.record_pages(pool.capacity, 0, 0)
+        return pool
+
+    def _record_pages(self, pool) -> None:
+        st = pool.stats()
+        self.metrics.record_pages(st["total"], st["used"], st["shared"])
 
     def _flight_dump(self, reason: str) -> None:
         """Dump the flight ring (never raises — rides failure paths)."""
@@ -310,8 +392,8 @@ class ServeEngine:
         self._finalized = True
         self._flight_dump("close")
         try:
-            for prog in ("lm_decode_rows", "lm_prefill_slot",
-                         "lm_generate_batch"):
+            for prog in ("lm_decode_paged", "lm_prefill_paged",
+                         "lm_decode_rows", "lm_prefill_slot"):
                 perf.get_program_costs().emit(prog)
         except Exception:
             pass
@@ -334,7 +416,15 @@ class ServeEngine:
                 return  # a wedged generation the breaker gave up on: it
                 # may never return from its device call, and everything it
                 # held was already retired — joining would hang shutdown
-            t.join()
+            try:
+                t.join()
+            except RuntimeError:
+                # a recovery publishes the fresh generation's thread under
+                # the lock but starts it only after releasing it; joining
+                # inside that window raises "cannot join thread before it
+                # is started" — yield and re-join once the starter runs
+                time.sleep(0.001)
+                continue
             with self._cond:
                 if self._thread is not t:
                     waited = 0.0
@@ -476,8 +566,23 @@ class ServeEngine:
                     f" > deadline {request.deadline:.3f} at queue depth "
                     f"{self._queue.count} (service est "
                     f"{self._service_ewma:.3f}s)"))
-        cost = bucket_kv_bytes(self.params, self.heads, bucket,
-                               self.compute_dtype)
+        if self.paged:
+            # admission charges the request's ACTUAL pages (the memory its
+            # cache rows can ever write — planner.request_pages), not the
+            # bucket worst case: short requests in long buckets stop
+            # reserving capacity they never use
+            from ..models.planner import request_pages
+
+            pages = request_pages(request.prompt.shape[0], request.steps,
+                                  self._page_len)
+            if pages > self._num_pages - 1:
+                return self._refuse(handle, STATUS_REJECTED, (
+                    f"request needs {pages} KV pages but the pool holds "
+                    f"{self._num_pages - 1} (serve_num_pages)"))
+            cost = pages * self._page_bytes
+        else:
+            cost = bucket_kv_bytes(self.params, self.heads, bucket,
+                                   self.compute_dtype)
         reason = self._queue.try_admit(cost)
         if reason is not None:
             # a drain/close-shut gate is a deterministic shutting_down
@@ -527,10 +632,10 @@ class ServeEngine:
     # ----------------------------------------------------------- worker loop
 
     def _run(self, gen: int = 0) -> None:
-        if self.rowlevel:
-            self._run_rowlevel(gen)
+        if self.paged:
+            self._run_paged(gen)
         else:
-            self._run_gang(gen)
+            self._run_rowlevel(gen)
 
     def _crash_handler(self, exc: BaseException, held: list,
                        gen: int) -> bool:
@@ -565,7 +670,6 @@ class ServeEngine:
             else:
                 leftovers = self._former.take_all()
                 self._state = "closing"
-            self._inflight = []
             self._claimed = []
         self._flight_dump("worker-died")
         if supervised:
@@ -579,55 +683,6 @@ class ServeEngine:
                 self._retire(e, Result(e.request.rid, STATUS_ERROR,
                                        reason="serving worker died"))
         return False
-
-    def _run_gang(self, gen: int) -> None:
-        inflight = []
-        try:
-            while True:
-                if self._gen == gen:  # a superseded straggler must never
-                    self._heartbeat = time.monotonic()  # fake a live pulse
-                faults.fire("serve.worker_crash",
-                            path=threading.current_thread().name)
-                batch = None
-                with self._cond:
-                    while True:
-                        if self._gen != gen:
-                            return  # superseded by a recovery
-                        if self._state == "closing":
-                            return
-                        draining = self._state == "draining"
-                        batch = self._former.next_batch(self._clock(),
-                                                        force=draining)
-                        if batch[0] is not None:
-                            break
-                        if draining:
-                            return  # nothing pending; in-flight is us
-                        hint = batch[1]
-                        self._idle = True
-                        if self._real_clock:
-                            # submit/drain/close all notify — idle waits
-                            # need no polling on the real clock
-                            self._cond.wait(hint)
-                        else:
-                            # injected clock: cap the real wait so advances
-                            # between polls are observed promptly
-                            self._cond.wait(
-                                _POLL_CAP_S if hint is None
-                                else min(max(hint, 1e-4), _POLL_CAP_S))
-                        self._idle = False
-                        if self._gen == gen:
-                            self._heartbeat = time.monotonic()
-                    inflight = batch[1]
-                    self._inflight = inflight
-                self._execute(*batch)
-                inflight = []
-                with self._cond:
-                    if self._gen == gen:  # never clobber a successor's
-                        self._inflight = []  # in-flight mirror
-        except BaseException as exc:  # worker death: recover or fail held
-            if self._crash_handler(exc, inflight, gen):
-                return
-            raise
 
     def _retire(self, entry: _Entry, result: Result) -> None:
         if entry.superseded:
@@ -663,7 +718,9 @@ class ServeEngine:
                 queue_s=result.metrics.get("queue_s"),
                 total_s=result.metrics.get("total_s"),
                 ttft_s=result.metrics.get("ttft_s"),
-                attempt=entry.attempt)
+                attempt=entry.attempt,
+                pages=result.metrics.get("pages"),
+                shared_pages=result.metrics.get("shared_pages"))
         self.metrics.record_queue(self._queue.count,
                                   self._queue.bytes_in_flight)
 
@@ -1019,7 +1076,7 @@ class ServeEngine:
                 self._crash = None
             else:
                 # stuck path: steal what the stale (still-alive) worker
-                # holds — its pools/claimed/inflight mirrors. The straggler
+                # holds — its pools/claimed mirrors. The straggler
                 # mutates pool.entries WITHOUT this lock, so snapshot each
                 # list and skip holes rather than indexing live_slots()
                 # (an entry it retires concurrently shows up handle-done
@@ -1027,10 +1084,14 @@ class ServeEngine:
                 # crash the recovery)
                 stash = [e for p in self._pools.values()
                          for e in list(p.entries) if e is not None]
-                stash += list(self._claimed) + list(self._inflight)
+                stash += list(self._claimed)
             self._pools = {}
-            self._inflight = []
             self._claimed = []
+            # the paged pool's slab/block-table/prefix-cache state died
+            # with the worker: drop it wholesale; it rebuilds zeroed on
+            # the fresh generation's first admission (page-unit admission
+            # reservations ride the requeued twins, never re-charged)
+            self._kvpool = None
             seen = set()
             for e in stash:
                 if id(e) in seen or e.handle.done() or e.superseded:
@@ -1076,87 +1137,482 @@ class ServeEngine:
         deleted = getattr(pool.tokens, "is_deleted", None)
         return bool(deleted and deleted())
 
-    # ---------------------------------------------------- gang scheduler
+    # --------------------------------------------------- paged scheduler
 
-    def _execute(self, group_key, entries) -> None:
-        """One engine cycle: expire stale rows, prefill live rows into the
-        bucket's fixed-width slot batch, run the compiled program, retire."""
-        import jax
-
-        from ..models.transformer import lm_generate_batch
-
-        bucket, temperature, top_p, top_k, _ = group_key
-        # sampled groups share one seed (the former keys on it); greedy
-        # groups ignore the key entirely, so any member's seed serves
-        p, s = bucket
-        dispatch_t = self._clock()
-        live = []
-        for e in entries:
-            dl = e.request.deadline
-            if dl is not None and dl <= dispatch_t:
-                self._retire(e, Result(
-                    e.request.rid, STATUS_EXPIRED,
-                    reason=f"deadline {dl} passed before dispatch "
-                           f"(dispatched at {dispatch_t})",
-                    metrics={"bucket": bucket,
-                             "queue_s": dispatch_t - e.enq_t,
-                             "total_s": dispatch_t - e.enq_t}))
-            else:
-                live.append(e)
-        if not live:
-            return
-        self._live_rows = len(live)
-        capture_bucket_costs(self.params, self.heads, bucket, self.max_batch,
-                             self.compute_dtype, self.moe, rowlevel=False,
-                             key=self._prog_key(bucket))
+    def _run_paged(self, gen: int) -> None:
+        """The paged slot-step loop: each iteration refills freed rows from
+        the queue (page allocation + prefix match — host-side), runs
+        prefill chunks up to the ``serve_prefill_chunk`` TOKEN budget
+        (oldest rows first), then one decode step per bucket over its live
+        rows — chunked prefill interleaves with decode, so a long prompt
+        never monopolizes an iteration. ``pools`` maps bucket -> PagedGroup
+        over the engine's
+        one shared :class:`PagedKVPool`; ``pf_queue`` is the FIFO of rows
+        mid-prefill ((bucket, slot, rid) — rid guards against a retired
+        slot's re-occupant inheriting a stale cursor). Mirrors for
+        supervisor recovery as in the slab loop."""
+        pools: dict[tuple, PagedGroup] = {}
+        with self._cond:
+            if self._gen != gen:
+                return  # superseded before the first iteration
+            self._pools = pools
+            # the GENERATION-LOCAL pool binding: every helper below takes
+            # this pool, never self._kvpool — a stuck-but-alive superseded
+            # worker resuming mid-iteration must mutate only its own dead
+            # pool, not the replacement generation's (page ids are
+            # meaningless across pools; a cross-generation release would
+            # silently double-book pages under live rows). Bound UNDER the
+            # lock with the generation re-checked, so a racing recovery
+            # can never hand two generations one pool.
+            pool = self._ensure_kvpool()
+        pf_queue: collections.deque = collections.deque()
+        claimed: list[_Entry] = []
         try:
-            faults.fire("serve.step", path=f"bucket-{p}x{s}")
-            # prefill the claimed slots; free slots carry inert dummy rows so
-            # the batch shape (and the compiled program) never varies
-            prompts = np.zeros((self.max_batch, p), np.int32)
-            lengths = np.ones((self.max_batch,), np.int32)
-            for i, e in enumerate(live):
-                n = e.request.prompt.shape[0]
-                prompts[i, :n] = e.request.prompt
-                lengths[i] = n
-            key = jax.random.key(live[0].request.seed)
-            t0 = time.perf_counter()
-            out = np.asarray(lm_generate_batch(
-                self.params, prompts, lengths, key, heads=self.heads,
-                max_len=p + s, steps=s, temperature=temperature, top_p=top_p,
-                top_k=top_k, compute_dtype=self.compute_dtype, moe=self.moe))
+            while True:
+                if self._gen == gen:  # a superseded straggler must never
+                    self._heartbeat = time.monotonic()  # fake a live pulse
+                faults.fire("serve.worker_crash",
+                            path=threading.current_thread().name)
+                claimed = []
+                with self._cond:
+                    while True:
+                        if self._gen != gen:
+                            return  # superseded by a recovery
+                        busy = any(p.occupied_slots()
+                                   for p in pools.values())
+                        if self._state == "closing":
+                            # resident rows (live AND mid-prefill) are the
+                            # work in flight: finish them (close() already
+                            # emptied the former)
+                            if not busy:
+                                return
+                            break
+                        draining = self._state == "draining"
+                        claimed = self._claim_rowlevel(pools)
+                        if claimed or busy:
+                            break
+                        if draining:
+                            return  # nothing queued, nothing resident
+                        self._idle = True
+                        self._cond.wait(None if self._real_clock
+                                        else _POLL_CAP_S)
+                        self._idle = False
+                        if self._gen == gen:
+                            self._heartbeat = time.monotonic()
+                    self._claimed = claimed
+                with self._cond:
+                    if self._gen == gen and pool is not self._kvpool:
+                        # this generation dropped its pool (slab consumed
+                        # by a failed donated call): rebind to the rebuilt
+                        # one — the old object's arrays are deleted. Under
+                        # the lock + gen check: a stale generation must
+                        # never build (or adopt) the live generation's
+                        # pool
+                        pool = self._ensure_kvpool()
+                self._admit_paged(pool, pools, claimed, pf_queue)
+                claimed = []
+                with self._cond:
+                    if self._gen == gen:  # never clobber a successor's
+                        self._claimed = []  # claimed mirror
+                self._prefill_paged_chunk(pool, pools, pf_queue)
+                self._step_paged(pool, pools)
+        except BaseException as exc:  # worker death: recover or fail held
+            held = [p.entries[i] for p in pools.values()
+                    for i in p.occupied_slots()]
+            if self._crash_handler(exc, claimed + held, gen):
+                return
+            raise
+
+    def _admit_paged(self, pool, pools, claimed, pf_queue) -> None:
+        """Bind each claimed entry to a free row of its bucket's group:
+        prefix-cache match, page allocation (the admission charge was
+        taken in page units at submit, so the alloc cannot fail under
+        engine traffic — still guarded), block table build. Host-side
+        only; the device work happens chunk by chunk in
+        :meth:`_prefill_paged_chunk`."""
+        if not claimed:
+            return
+        from ..models.planner import request_pages
+
+        # dispatch order ACROSS buckets: _claim_rowlevel walks an unordered
+        # bucket set, but the prefill queue is the TTFT ledger — higher
+        # priority first, then arrival (rid is monotonic per process), so a
+        # short early request never waits out a later long prompt's chunks
+        claimed = sorted(claimed,
+                         key=lambda e: (-e.request.priority, e.request.rid))
+        for e in claimed:
+            with obs_trace.use(e.trace):
+                now = self._clock()
+                r = e.request
+                if r.deadline is not None and r.deadline <= now:
+                    self._retire(e, Result(
+                        r.rid, STATUS_EXPIRED,
+                        reason=f"deadline {r.deadline} passed before "
+                               f"dispatch (dispatched at {now})",
+                        metrics={"bucket": e.bucket,
+                                 "queue_s": now - e.enq_t,
+                                 "total_s": now - e.enq_t}))
+                    continue
+                e.queue_s = now - e.enq_t
+                group = pools.get(e.bucket)
+                if group is None:
+                    group = pools[e.bucket] = PagedGroup(
+                        e.bucket, self.max_batch, self._page_len,
+                        self._prefill_chunk)
+                    # no-warmup path: the bucket's cost model still lands
+                    # with its first (lazy) compile
+                    capture_paged_costs(
+                        self.params, self.heads, e.bucket, self.max_batch,
+                        pool, self._prefill_chunk, self.compute_dtype,
+                        self.moe, key=self._prog_key(e.bucket))
+                slot = group.free_slots()[0]
+                n = r.prompt.shape[0]
+                shared_len, spages = pool.match_prefix(r.prompt)
+                need = request_pages(n, r.steps, self._page_len)
+                try:
+                    owned = pool.alloc(need - len(spages))
+                except PagePoolExhausted as exc:
+                    pool.release(spages)  # drop the refs the match took
+                    reason = f"page allocation failed: {exc}"
+                    if e.attempts_left():
+                        self._requeue(e, reason)
+                    else:
+                        self._retire(e, Result(
+                            r.rid, STATUS_ERROR, reason=reason,
+                            metrics={"bucket": e.bucket,
+                                     "queue_s": e.queue_s,
+                                     "total_s": now - e.enq_t}))
+                    continue
+                group.assign(slot, e, spages + owned, shared_len,
+                             len(spages))
+                pf_queue.append((e.bucket, slot, r.rid))
+                self.metrics.record_prefix(hit=bool(spages))
+                self.metrics.record_page_event(
+                    "alloc", rid=r.rid, pages=len(spages) + len(owned),
+                    shared=len(spages), used=pool.used_count(),
+                    total=pool.capacity)
+        self._record_pages(pool)
+
+    def _prefill_paged_chunk(self, pool, pools, pf_queue) -> None:
+        """Run bounded prefill for this iteration — the chunked-prefill
+        scheduling contract: at most ``serve_prefill_chunk`` prompt TOKENS
+        of prefill per worker iteration (several short prompts may share
+        the budget; one long prompt consumes it in a single chunk and
+        resumes next iteration), decode steps interleaving in between so a
+        long prompt never monopolizes the worker. Rows prefill oldest
+        first — FIFO TTFT fairness. A row's final chunk (the one
+        containing the prompt's last token) emits its first token — real
+        TTFT — caches the completed prompt pages for prefix sharing, and
+        flips the row decode-ready."""
+        budget = self._prefill_chunk
+        while budget > 0 and pf_queue:
+            budget -= self._prefill_one_chunk(pool, pools, pf_queue)
+        self._live_rows = sum(len(g.live_slots()) for g in pools.values())
+
+    def _prefill_one_chunk(self, pool, pools, pf_queue) -> int:
+        """One chunk for the head of the prefill queue; returns the real
+        prompt tokens it consumed (0 ends the caller's budget loop —
+        nothing left to prefill, or the head row just failed)."""
+        while pf_queue:
+            bucket, slot, rid = pf_queue[0]
+            group = pools.get(bucket)
+            e = group.entries[slot] if group is not None else None
+            if (e is None or e.request.rid != rid
+                    or group.pf_next[slot] < 0):
+                pf_queue.popleft()  # stale: retired/expired/re-occupied
+                continue
+            break
+        else:
+            return 0
+        with obs_trace.use(e.trace):
+            r = e.request
+            p, s = bucket
+            cs = int(group.pf_next[slot])
+            n = int(group.lengths[slot])
+            C = group.chunk
+            tokens = min(C, n - cs)
+            final = cs + C >= n
+            chunk = group.prompts[slot][cs:cs + C]
+            if chunk.shape[0] < C:
+                # a prefix hit whose shared_len is page- but not CHUNK-
+                # aligned leaves a short tail slice; pad it back to the
+                # compiled width — a narrower array would compile a fresh
+                # program per width and break the <=3-per-bucket bound
+                chunk = np.concatenate(
+                    [chunk, np.zeros(C - chunk.shape[0], np.int32)])
+            try:
+                # copy-on-write gate on every page the chunk will scatter
+                # into (a no-op in steady state: writes target owned pages
+                # by construction — kvpool.PagedKVPool.ensure_writable)
+                for j in range(cs // self._page_len,
+                               min((cs + C) // self._page_len,
+                                   group.pages_per_row)):
+                    self._cow(pool, group, slot, j, rid=r.rid)
+                from ..models.transformer import lm_prefill_paged
+
+                faults.fire("serve.prefill", path=f"bucket-{p}x{s}")
+                t0 = time.perf_counter()
+                pages, first = lm_prefill_paged(
+                    self.params, pool.pages, group.tables[slot], chunk, cs,
+                    n, heads=self.heads, page_len=self._page_len,
+                    seed=r.seed, temperature=r.temperature, top_p=r.top_p,
+                    top_k=r.top_k, compute_dtype=self.compute_dtype,
+                    moe=self.moe)
+                first = int(first)  # device sync: the chunk landed
+                wall = time.perf_counter() - t0
+            except Exception as exc:
+                pf_queue.popleft()
+                self._paged_prefill_failure(pool, pools, bucket, slot, exc)
+                return 0  # end this iteration's budget loop
+            pool.pages = pages
+            group.pf_next[slot] = cs + C
+            self.metrics.record_prefill(
+                e.bucket, wall, rid=r.rid,
+                program_key=self._prog_key(e.bucket),
+                program="lm_prefill_paged", chunk=[cs, tokens], final=final)
+            self.flight.record(
+                "prefill", bucket=[p, s], slot=slot, rid=r.rid,
+                seconds=wall, chunk=[cs, tokens],
+                queue_depth=self._queue.count, compiles=_compile_count(),
+                pages_used=pool.used_count())
+            if final:
+                pf_queue.popleft()
+                group.finish_prefill(slot, first)
+                group.ttft_s[slot] = self._clock() - e.enq_t
+                # the prompt's full pages are final now — publish them for
+                # copy-on-write reuse by later identical prefixes
+                pool.insert_prefix(r.prompt, group.row_pages[slot])
+                self._record_pages(pool)
+                if r.steps == 1 or (r.eos is not None and first == r.eos):
+                    self._retire_row_paged(pool, pools, bucket, slot,
+                                           STATUS_OK, self._clock())
+        return tokens
+
+    def _cow(self, pool, group, slot: int, table_idx: int,
+             rid: int | None = None) -> None:
+        """Engine-side copy-on-write: splits the page and keeps the group's
+        release bookkeeping in step with the table (kvpool owns the device
+        copy — ONE compiled program per slab shape)."""
+        old = int(group.tables[slot, table_idx])
+        if pool.ensure_writable(group.tables[slot], table_idx):
+            rp = group.row_pages[slot]
+            rp[table_idx] = int(group.tables[slot, table_idx])
+            if group.shared_pages[slot] > 0:
+                group.shared_pages[slot] -= 1
+            self.metrics.record_page_event(
+                "cow", rid=rid, pages=1, used=pool.used_count(),
+                total=pool.capacity)
+            self.flight.record("cow", slot=slot, page=old,
+                               fresh=rp[table_idx],
+                               pages_used=pool.used_count())
+
+    def _step_paged(self, pool, pools) -> None:
+        """Retire expired resident rows, then run ONE decode step per
+        bucket over its live rows. All buckets' steps are dispatched before
+        any result is awaited (async dispatch overlap, as in the slab
+        loop); non-live rows run the masked-harmless dummy against page 0
+        so a prefilling neighbor's pages are never scribbled."""
+        from ..models.transformer import lm_decode_paged
+
+        launched = []
+        for bucket, group in list(pools.items()):
+            now = self._clock()
+            for i in group.occupied_slots():
+                dl = group.entries[i].request.deadline
+                if dl is not None and dl <= now:
+                    self._retire_row_paged(
+                        pool, pools, bucket, i, STATUS_EXPIRED, now,
+                        reason=f"deadline {dl} passed mid-decode "
+                               f"(now {now})")
+            live = group.live_slots()
+            if not live:
+                continue
+            p, s = bucket
+            try:
+                for i in live:  # COW gate on each row's write page
+                    self._cow(pool, group, slot=i,
+                              table_idx=int(group.positions[i])
+                              // self._page_len,
+                              rid=group.entries[i].request.rid)
+                faults.fire("serve.decode_step", path=f"bucket-{p}x{s}")
+                t0 = time.perf_counter()
+                tables, positions, cur = group.decode_inputs()
+                pages, nxt = lm_decode_paged(
+                    self.params, pool.pages, tables, positions, cur,
+                    group.steps_done, group.seeds, group.temperature,
+                    group.top_p, group.top_k, heads=self.heads,
+                    page_len=self._page_len,
+                    compute_dtype=self.compute_dtype, moe=self.moe)
+            except Exception as exc:
+                self._fail_paged_bucket(pool, pools, bucket, exc)
+                continue
+            pool.pages = pages
+            launched.append((bucket, group, live, t0, nxt))
+        for bucket, group, live, t0, nxt in launched:
+            try:
+                nxt = np.asarray(nxt)  # sync; the per-row emitted tokens
+            except Exception as exc:
+                self._fail_paged_bucket(pool, pools, bucket, exc)
+                continue
             wall = time.perf_counter() - t0
-        except Exception as exc:
-            reason = f"batch failed: {type(exc).__name__}: {exc}"
-            self.flight.record("batch_fault", bucket=[p, s], rows=len(live),
-                               error=reason, queue_depth=self._queue.count,
-                               compiles=_compile_count())
-            done_t = self._clock()
-            for e in live:
+            self.metrics.record_step(bucket, len(live), self.max_batch,
+                                     wall, program_key=self._prog_key(bucket),
+                                     program="lm_decode_paged")
+            self.flight.record(
+                "step", bucket=list(bucket), rows=len(live),
+                seconds=wall, queue_depth=self._queue.count,
+                compiles=_compile_count(), pages_used=pool.used_count())
+            now = self._clock()
+            for i in live:
+                if group.entries[i] is None:
+                    continue  # expired between dispatch and landing
+                group.positions[i] += 1
+                group.steps_done[i] += 1
+                tok = int(nxt[i])
+                group.cur_tok[i] = tok
+                group.emitted[i].append(tok)
+                r = group.entries[i].request
+                if ((r.eos is not None and tok == r.eos)
+                        or int(group.steps_done[i]) >= r.steps):
+                    self._retire_row_paged(pool, pools, bucket, i,
+                                           STATUS_OK, now)
+        self._live_rows = sum(len(g.live_slots()) for g in pools.values())
+
+    def _retire_row_paged(self, pool, pools, bucket, slot: int,
+                          status: str, now: float, reason: str = "") -> None:
+        """Retire one paged row and free its slot — the ONLY path a
+        resident row leaves a group by, so every terminal status releases
+        the row's pages AND its page-unit admission reservation exactly
+        once (pages here via the pool refcount, the reservation in
+        :meth:`_retire` by whoever wins the handle)."""
+        group = pools[bucket]
+        e = group.entries[slot]
+        n_pages = len(group.row_pages[slot] or [])
+        metrics = {"bucket": bucket, "slot": slot, "queue_s": e.queue_s,
+                   "ttft_s": group.ttft_s[slot],
+                   "total_s": now - e.enq_t, "pages": n_pages,
+                   "shared_pages": int(group.shared_pages[slot])}
+        if status == STATUS_OK:
+            toks = np.concatenate([
+                np.asarray(e.request.prompt, np.int32),
+                np.asarray(group.emitted[slot], np.int32)])
+            result = Result(e.request.rid, STATUS_OK, tokens=toks,
+                            metrics=metrics)
+        else:
+            result = Result(e.request.rid, status, reason=reason,
+                            metrics=metrics)
+        pages = group.release(slot)
+        if pool is not None:
+            pool.release(pages)
+            # inside the request's span: the free record must join the
+            # request's trace whichever step retires it
+            with obs_trace.use(e.trace):
+                self.metrics.record_page_event(
+                    "free", rid=e.request.rid, pages=len(pages),
+                    used=pool.used_count(), total=pool.capacity)
+            self._record_pages(pool)
+        self._retire(e, result)
+
+    def _paged_pool_lost(self, pool) -> bool:
+        """True when a failed donated call consumed the page slab (the
+        paged analog of :meth:`_slab_lost`)."""
+        if pool is None:
+            return False
+        leaf = pool.pages["l0"][0]
+        deleted = getattr(leaf, "is_deleted", None)
+        return bool(deleted and deleted())
+
+    def _drop_paged_pool(self, pool, pools, reason: str) -> None:
+        """The calling generation's slab died under a failed donated
+        call: every resident row in its EVERY bucket lost its cache —
+        requeue each within its attempt budget (the page-unit reservation
+        is carried), fail the rest, and drop the pool; the live worker
+        rebinds a zeroed rebuild at its next iteration (the same contract
+        as worker-crash recovery). A STALE generation reaching here
+        clears only its own (already superseded) map — the engine-level
+        pool reference is cleared only when it still names this pool."""
+        now = self._clock()
+        for bucket, group in list(pools.items()):
+            for i in group.occupied_slots():
+                e = group.entries[i]
+                group.release(i)  # page bookkeeping dies with the pool
                 if e.attempts_left():
                     self._requeue(e, reason)
                 else:
                     self._retire(e, Result(
                         e.request.rid, STATUS_ERROR, reason=reason,
-                        metrics={"bucket": bucket,
-                                 "queue_s": dispatch_t - e.enq_t,
-                                 "total_s": done_t - e.enq_t}))
-            self._live_rows = 0
-            self._flight_dump("batch-failed")
+                        metrics={"bucket": bucket, "queue_s": e.queue_s,
+                                 "total_s": now - e.enq_t}))
+        pools.clear()
+        if self._kvpool is pool:
+            self._kvpool = None
+            self.metrics.record_page_event("lost", used=0,
+                                           total=self._num_pages - 1)
+            self.metrics.record_pages(self._num_pages - 1, 0, 0)
+
+    def _fail_paged_bucket(self, pool, pools, bucket,
+                           exc: Exception) -> None:
+        """A paged decode step died: with the pool intact (an injected
+        fault raised before launch) only that step's live rows
+        fail/retry and their pages free; a consumed slab escalates to
+        :meth:`_drop_paged_pool`."""
+        group = pools.get(bucket)
+        if group is None or pool is not self._kvpool:
+            # an earlier bucket's failure in this same landing loop already
+            # escalated to _drop_paged_pool: every resident row (including
+            # this bucket's) was requeued/failed there — a second handling
+            # pass would KeyError on the cleared pools map
             return
-        done_t = self._clock()
-        for i, e in enumerate(live):
-            n = e.request.prompt.shape[0]
-            self._retire(e, Result(
-                e.request.rid, STATUS_OK,
-                tokens=out[i, : n + e.request.steps].copy(),
-                metrics={"bucket": bucket, "queue_s": dispatch_t - e.enq_t,
-                         "ttft_s": done_t - e.enq_t,
-                         "total_s": done_t - e.enq_t}))
-        self.metrics.record_batch(bucket, len(live), self.max_batch,
-                                  len(live) * s, wall,
-                                  program_key=self._prog_key(bucket))
-        self.flight.record("batch", bucket=[p, s], rows=len(live),
-                           seconds=wall, queue_depth=self._queue.count,
-                           compiles=_compile_count())
-        self._live_rows = 0
+        reason = f"decode step failed: {type(exc).__name__}: {exc}"
+        self.flight.record("decode_fault", bucket=list(bucket),
+                           rows=len(group.live_slots()), error=reason,
+                           queue_depth=self._queue.count,
+                           compiles=_compile_count(),
+                           pages_used=pool.used_count() if pool else 0)
+        if self._paged_pool_lost(pool):
+            self._drop_paged_pool(pool, pools, reason)
+        else:
+            now = self._clock()
+            for i in group.live_slots():
+                e = group.entries[i]
+                if e.attempts_left():
+                    pool.release(group.release(i))
+                    self._requeue(e, reason)
+                else:
+                    self._retire_row_paged(pool, pools, bucket, i,
+                                           STATUS_ERROR, now, reason=reason)
+            self._record_pages(pool)
+        self._flight_dump("decode-step-failed")
+
+    def _paged_prefill_failure(self, pool, pools, bucket, slot: int,
+                               exc: Exception) -> None:
+        """A prefill chunk died: the row being prefilled retries within
+        its attempt budget (the chunk cursor restarts from its shared
+        prefix on the retry — resumability is host state) or errors;
+        co-resident rows survive unless the slab was consumed."""
+        group = pools[bucket]
+        e = group.entries[slot]
+        reason = f"prefill failed: {type(exc).__name__}: {exc}"
+        self.flight.record("prefill_fault", bucket=list(bucket),
+                           rid=e.request.rid, error=reason,
+                           queue_depth=self._queue.count,
+                           compiles=_compile_count(),
+                           pages_used=pool.used_count() if pool else 0)
+        if self._paged_pool_lost(pool):
+            self._drop_paged_pool(pool, pools,
+                                  f"pool lost to a failed prefill: {reason}")
+        else:
+            now = self._clock()
+            pool.release(group.release(slot))
+            if e.attempts_left():
+                self._requeue(e, reason)
+            else:
+                self._retire(e, Result(
+                    e.request.rid, STATUS_ERROR, reason=reason,
+                    metrics={"bucket": bucket, "queue_s": e.queue_s,
+                             "total_s": now - e.enq_t}))
+            self.metrics.record_page_event(
+                "free", rid=e.request.rid, used=pool.used_count(),
+                total=pool.capacity)
+            self._record_pages(pool)
+        self._flight_dump("prefill-failed")

@@ -10,23 +10,32 @@ picked up) under the single kind ``"serve"`` with an ``ev`` discriminator:
 =============  ===========================================================
 ``enqueue``    ``rid``, ``bucket``, ``depth`` (queue depth after admit)
 ``reject``     ``rid``, ``reason``
-``prefill``    row-level scheduling, one per slot prefill: ``rid``,
-               ``bucket``, ``new_tokens`` (1 — the row's first token lands
-               here), ``seconds`` (prefill wall time)
-``batch``      gang scheduling, one per dispatched batch: ``bucket``,
-               ``rows`` (live), ``occupancy`` (live/max_batch),
-               ``new_tokens``, ``seconds`` (wall), ``tok_s``
-``step``       row-level scheduling, one per DECODE STEP over the slab:
-               ``bucket``, ``rows`` (live this step), ``occupancy``,
-               ``new_tokens`` (= live rows), ``seconds`` (wall decode-step
-               latency), ``tok_s`` — the per-step occupancy stream is how
-               slot refill is asserted (a finished row's slot shows
-               occupied again on the next step's record)
+``prefill``    one per prefill dispatch: ``rid``, ``bucket``, ``seconds``
+               (wall time), ``new_tokens`` (1 on the completing dispatch —
+               the row's first token lands there — else 0); paged chunked
+               prefill additionally carries ``chunk`` = [start, tokens]
+               (a long prompt emits one record per chunk, resumable across
+               worker iterations)
+``step``       one per DECODE STEP over a bucket's rows: ``bucket``,
+               ``rows`` (live this step), ``occupancy``, ``new_tokens``
+               (= live rows), ``seconds`` (wall decode-step latency),
+               ``tok_s`` — the per-step occupancy stream is how slot
+               refill is asserted (a finished row's slot shows occupied
+               again on the next step's record)
+``page``       paged KV pool accounting: ``action`` (``alloc`` at row
+               admission / ``free`` at retirement / ``cow`` on a
+               copy-on-write split / ``lost`` when a failed donated call
+               consumed the slab), ``rid``, ``pages`` (moved by this
+               action), ``shared`` (of them, prefix-cache shares), and
+               the pool ``used``/``total`` after it — the stream
+               ``obs.report`` turns into the prefix-hit-rate /
+               page-occupancy line
 ``retry``      ``rid``, ``attempt`` (the attempt about to run),
                ``max_attempts``, ``reason`` — one failed attempt re-queued
 ``result``     ``rid``, ``status``, ``bucket``, ``queue_s``, ``ttft_s``,
                ``total_s``; retried requests add ``attempt`` (the final,
-               serving attempt — latency is attributed to it)
+               serving attempt — latency is attributed to it); paged rows
+               add ``pages``/``shared_pages``
 =============  ===========================================================
 
 The engine activates each request's span context around the rid-carrying
@@ -37,19 +46,21 @@ In parallel, everything aggregates into the process registry
 (:mod:`marlin_tpu.obs.metrics`) so a ``/metrics`` scrape sees live serving
 state: ``marlin_serve_submitted_total``,
 ``marlin_serve_requests_total{status=...}``, ``marlin_serve_tokens_total``,
-``marlin_serve_dispatches_total{kind=batch|step|prefill}``,
+``marlin_serve_dispatches_total{kind=step|prefill}``,
 ``marlin_serve_busy_seconds_total``, gauges ``marlin_serve_queue_depth`` /
-``marlin_serve_slot_occupancy`` / ``marlin_serve_kv_inflight_bytes``, and
+``marlin_serve_slot_occupancy`` / ``marlin_serve_kv_inflight_bytes`` /
+``marlin_serve_kv_pages_total`` / ``marlin_serve_kv_pages_used`` /
+``marlin_serve_kv_pages_shared`` (paged pool state), the
+``marlin_serve_prefix_cache_total{result=hit|miss}`` counter, and
 histograms ``marlin_serve_ttft_seconds`` / ``marlin_serve_total_seconds`` /
 ``marlin_serve_step_seconds``.
 
 Latencies are measured on the engine's *injected* clock (deterministic
 tests), throughput (``tok_s``) on the real wall clock (it is a measurement,
-not a policy input). Under gang scheduling a row's first token becomes
-visible only when its batch's whole generation program returns, so
-``ttft_s`` equals ``total_s`` there; under row-level scheduling the first
-token lands with the slot's prefill, so ``ttft_s`` is genuinely earlier —
-the headline latency the row-level split buys (docs/serving.md).
+not a policy input). The first token lands with the row's (final) prefill
+dispatch, so ``ttft_s`` is genuinely earlier than ``total_s`` — the
+headline latency row-level scheduling buys, and what paged chunked prefill
+bounds under long-prompt load (docs/serving.md).
 
 :meth:`ServeMetrics.snapshot` aggregates everything for tests and the bench
 (`bench_all.py serve`) without re-reading the log file. Its percentiles run
@@ -116,7 +127,12 @@ class ServeMetrics:
         self.errors = 0
         self.shut_down = 0
         self.retries = 0
-        self.batches = 0
+        self.batches = 0  # legacy (gang scheduler, retired PR 8): always 0
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.pages_total = 0
+        self.pages_used = 0
+        self.pages_shared = 0
         self.steps = 0
         self.new_tokens = 0
         self.busy_s = 0.0
@@ -137,8 +153,9 @@ class ServeMetrics:
             "marlin_serve_tokens_total", "Generated tokens (all requests)")
         self._m_dispatch = reg.counter(
             "marlin_serve_dispatches_total",
-            "Engine dispatches by kind (gang batch / row-level decode step "
-            "/ slot prefill)", labelnames=("kind",))
+            "Engine dispatches by kind (decode step / prefill — one "
+            "prefill dispatch per chunk under paged chunked prefill)",
+            labelnames=("kind",))
         self._m_busy = reg.counter(
             "marlin_serve_busy_seconds_total",
             "Wall seconds the engine spent inside compiled programs")
@@ -162,6 +179,21 @@ class ServeMetrics:
             "marlin_serve_retries_total",
             "Failed attempts transparently re-queued (decode/prefill fault "
             "or worker crash) within the request's max_attempts budget")
+        self._m_pages_total = reg.gauge(
+            "marlin_serve_kv_pages_total",
+            "Allocatable pages in the paged KV pool (serve_num_pages minus "
+            "the dummy page)")
+        self._m_pages_used = reg.gauge(
+            "marlin_serve_kv_pages_used",
+            "Pages held by live rows and/or the prefix cache")
+        self._m_pages_shared = reg.gauge(
+            "marlin_serve_kv_pages_shared",
+            "Pages with more than one referent (copy-on-write prefix "
+            "sharing: cache + row, or row + row)")
+        self._m_prefix = reg.counter(
+            "marlin_serve_prefix_cache_total",
+            "Prefix-cache lookups at row admission by result (hit = at "
+            "least one full prompt page reused)", labelnames=("result",))
 
     def _emit(self, **fields) -> None:
         log = self._log or get_default_event_log()
@@ -188,57 +220,45 @@ class ServeMetrics:
         self._m_requests.labels(status="rejected").inc()
         self._emit(ev="reject", rid=rid, reason=reason)
 
-    def record_batch(self, bucket, rows: int, max_batch: int,
-                     new_tokens: int, seconds: float,
-                     program_key: str | None = None) -> None:
-        with self._lock:
-            self.batches += 1
-            self.new_tokens += new_tokens
-            self.busy_s += seconds
-            self._occupancy_sum += rows / max_batch
-        if program_key is not None:
-            get_program_costs().observe("lm_generate_batch", program_key,
-                                        seconds)
-        self._m_dispatch.labels(kind="batch").inc()
-        self._m_tokens.inc(new_tokens)
-        self._m_busy.inc(seconds)
-        self._m_occupancy.set(rows / max_batch)
-        self._emit(ev="batch", bucket=list(bucket), rows=rows,
-                   occupancy=round(rows / max_batch, 4),
-                   new_tokens=new_tokens, seconds=seconds,
-                   tok_s=round(new_tokens / max(seconds, 1e-9), 2))
-
     def record_prefill(self, bucket, seconds: float,
                        rid: int | None = None,
-                       program_key: str | None = None) -> None:
-        """One row-level slot prefill: the row's FIRST token is emitted here
-        (real TTFT), so it counts toward ``new_tokens``/``busy_s`` — without
-        this, steps=1 traffic would report zero tokens and every request
-        would be undercounted by one versus the gang accounting.
-        ``program_key`` joins the wall time onto the bucket's captured XLA
-        cost model (obs/perf.py) — the roofline side of the same record."""
+                       program_key: str | None = None,
+                       program: str = "lm_prefill_slot",
+                       chunk=None, final: bool = True) -> None:
+        """One prefill dispatch. The row's FIRST token is emitted by the
+        COMPLETING dispatch (real TTFT), so that one counts toward
+        ``new_tokens`` — without it, steps=1 traffic would report zero
+        tokens; paged chunked prefill additionally records one
+        zero-new-token event per earlier chunk (``chunk`` = [start,
+        tokens], ``final=False``). ``program_key`` joins the wall time onto
+        the bucket's captured XLA cost model for ``program`` (obs/perf.py)
+        — the roofline side of the same record."""
+        emitted = 1 if final else 0
         with self._lock:
-            self.new_tokens += 1
+            self.new_tokens += emitted
             self.busy_s += seconds
         if program_key is not None:
-            get_program_costs().observe("lm_prefill_slot", program_key,
-                                        seconds)
+            get_program_costs().observe(program, program_key, seconds)
         self._m_dispatch.labels(kind="prefill").inc()
-        self._m_tokens.inc()
+        if emitted:
+            self._m_tokens.inc()
         self._m_busy.inc(seconds)
-        fields = {"ev": "prefill", "bucket": list(bucket), "new_tokens": 1,
-                  "seconds": seconds}
+        fields = {"ev": "prefill", "bucket": list(bucket),
+                  "new_tokens": emitted, "seconds": seconds}
+        if chunk is not None:
+            fields["chunk"] = list(chunk)
         if rid is not None:
             fields["rid"] = rid
         self._emit(**fields)
 
     def record_step(self, bucket, rows: int, max_batch: int,
                     seconds: float,
-                    program_key: str | None = None) -> None:
-        """One row-level decode step over a bucket's slab: ``rows`` live
-        slots each emitted one token (``new_tokens`` == ``rows``).
-        ``program_key`` joins the step's wall time onto the decode
-        program's cost model, feeding ``marlin_program_roofline_frac``."""
+                    program_key: str | None = None,
+                    program: str = "lm_decode_rows") -> None:
+        """One decode step over a bucket's rows: ``rows`` live slots each
+        emitted one token (``new_tokens`` == ``rows``). ``program_key``
+        joins the step's wall time onto ``program``'s cost model, feeding
+        ``marlin_program_roofline_frac``."""
         with self._lock:
             self.steps += 1
             self.new_tokens += rows
@@ -246,8 +266,7 @@ class ServeMetrics:
             self._step_occupancy_sum += rows / max_batch
             self._step_s.add(seconds)
         if program_key is not None:
-            get_program_costs().observe("lm_decode_rows", program_key,
-                                        seconds)
+            get_program_costs().observe(program, program_key, seconds)
         self._m_dispatch.labels(kind="step").inc()
         self._m_tokens.inc(rows)
         self._m_busy.inc(seconds)
@@ -270,11 +289,48 @@ class ServeMetrics:
         self._emit(ev="retry", rid=rid, attempt=attempt,
                    max_attempts=max_attempts, reason=reason)
 
+    def record_pages(self, total: int, used: int, shared: int) -> None:
+        """Live paged-pool state (the engine calls this after admissions,
+        retirements, and pool drops) — gauges only, no EventLog record."""
+        with self._lock:
+            self.pages_total = total
+            self.pages_used = used
+            self.pages_shared = shared
+        self._m_pages_total.set(total)
+        self._m_pages_used.set(used)
+        self._m_pages_shared.set(shared)
+
+    def record_prefix(self, hit: bool) -> None:
+        """One prefix-cache lookup at row admission (hit = at least one
+        full prompt page reused instead of re-prefilled)."""
+        with self._lock:
+            if hit:
+                self.prefix_hits += 1
+            else:
+                self.prefix_misses += 1
+        self._m_prefix.labels(result="hit" if hit else "miss").inc()
+
+    def record_page_event(self, action: str, rid: int | None = None,
+                          pages: int | None = None,
+                          shared: int | None = None,
+                          used: int | None = None,
+                          total: int | None = None) -> None:
+        """One ``ev="page"`` EventLog record (see the module table); the
+        stream obs.report aggregates into the paging line."""
+        fields = {"ev": "page", "action": action}
+        for name, v in (("rid", rid), ("pages", pages), ("shared", shared),
+                        ("used", used), ("total", total)):
+            if v is not None:
+                fields[name] = v
+        self._emit(**fields)
+
     def record_result(self, rid: int, status: str, bucket=None,
                       queue_s: float | None = None,
                       total_s: float | None = None,
                       ttft_s: float | None = None,
-                      attempt: int = 1) -> None:
+                      attempt: int = 1,
+                      pages: int | None = None,
+                      shared_pages: int | None = None) -> None:
         with self._lock:
             if status == "ok":
                 self.completed += 1
@@ -288,11 +344,12 @@ class ServeMetrics:
                 self._total_s.add(total_s)
             if queue_s is not None:
                 self._queue_s.add(queue_s)
-            # ttft falls back to total_s ONLY for completed gang results
-            # (their first token really does surface with the whole batch);
+            # ttft falls back to total_s ONLY for completed results with no
+            # measured first-token time (legacy streams; every current
+            # scheduler stamps ttft at the final prefill dispatch);
             # expired/error requests never produced a token, and counting
             # their wait as time-to-first-token would corrupt the headline
-            # percentile the row-level A/B measures
+            # percentile the serving A/Bs measure
             if ttft_s is None and status == "ok":
                 ttft_s = total_s
             if ttft_s is not None:
@@ -313,12 +370,16 @@ class ServeMetrics:
             fields["ttft_s"] = ttft_s
         if total_s is not None:
             fields["total_s"] = total_s
+        if pages is not None:
+            fields["pages"] = pages
+        if shared_pages is not None:
+            fields["shared_pages"] = shared_pages
         self._emit(**fields)
 
     def snapshot(self) -> dict:
-        """One aggregate dict: counters plus occupancy mean (over gang
-        batches and row-level decode steps alike), tokens/s over engine busy
-        time, and p50/p99 total / ttft latency (None until data; percentiles
+        """One aggregate dict: counters (paging hit/page fields included)
+        plus decode-step occupancy mean, tokens/s over engine busy time,
+        and p50/p99 total / ttft latency (None until data; percentiles
         over the uniform reservoirs)."""
         with self._lock:
             lat = self._total_s.values()
@@ -333,6 +394,11 @@ class ServeMetrics:
                 "errors": self.errors, "shut_down": self.shut_down,
                 "retries": self.retries,
                 "batches": self.batches, "steps": self.steps,
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "pages_total": self.pages_total,
+                "pages_used": self.pages_used,
+                "pages_shared": self.pages_shared,
                 "new_tokens": self.new_tokens,
                 "busy_s": round(self.busy_s, 6),
                 "occupancy_mean": (round(occ / dispatches, 4)

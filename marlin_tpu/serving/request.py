@@ -51,24 +51,19 @@ class Request:
     first; FIFO among equals). Sampling knobs mirror
     :func:`~marlin_tpu.models.transformer.lm_generate_batch`.
 
-    ``seed`` feeds the sampling PRNG. Under the row-level scheduler (the
-    default) each slot row draws its own ``fold_in(key(seed), step)``
-    stream, so a sampled output replays from (seed, prompt) alone —
-    composition-independent, and any knob mix shares a decode step (the
-    knobs are per-row traced). Under the gang fallback the whole batch
-    decodes under one key: requests with different knobs never share a
-    batch, sampled requests batch only with same-seed peers, and exact
-    replay additionally needs the same submission pattern (batch width is
-    fixed, so the row index is what matters). Greedy decode, the default,
-    ignores the key entirely (docs/serving.md).
+    ``seed`` feeds the sampling PRNG: each row draws its own
+    ``fold_in(key(seed), step)`` stream, so a sampled output replays from
+    (seed, prompt) alone — composition-independent across batch makeup,
+    bucket padding, page boundaries, and prefix sharing — and any knob mix
+    shares a decode step (the knobs are per-row traced). Greedy decode,
+    the default, ignores the key entirely (docs/serving.md).
 
-    ``eos`` names a stop token: under the row-level scheduler a row retires
-    the step it EMITS that token (its slot refills from the queue on the
-    next step), so ``Result.tokens`` may carry fewer than ``steps``
-    generated tokens, ending with the eos. Detection looks only at
-    GENERATED tokens — an eos-valued token inside the prompt or its pad
-    region never stops a row. The gang fallback runs its fused program to
-    completion and ignores ``eos``.
+    ``eos`` names a stop token: a row retires the step it EMITS that token
+    (its slot refills from the queue on the next step), so
+    ``Result.tokens`` may carry fewer than ``steps`` generated tokens,
+    ending with the eos. Detection looks only at GENERATED tokens — an
+    eos-valued token inside the prompt or its pad region never stops a
+    row.
 
     ``deadline_s`` is the *relative* form of ``deadline``: seconds from
     submission, resolved to an absolute engine-clock deadline inside

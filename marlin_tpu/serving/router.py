@@ -351,8 +351,9 @@ class Router:
         agg: dict = {"replicas": {i: s for i, s in snaps}}
         for key in ("submitted", "rejected", "expired", "completed",
                     "errors", "shut_down", "retries", "batches", "steps",
-                    "new_tokens"):
-            agg[key] = sum(s[key] for _, s in snaps)
+                    "new_tokens", "prefix_hits", "prefix_misses",
+                    "pages_total", "pages_used", "pages_shared"):
+            agg[key] = sum(s.get(key, 0) for _, s in snaps)
         busy = sum(s["busy_s"] for _, s in snaps)
         agg["busy_s"] = round(busy, 6)
         agg["tok_s"] = (round(agg["new_tokens"] / busy, 2) if busy > 0

@@ -83,7 +83,8 @@ __all__ = [
 KNOWN_POINTS = frozenset({
     "ckpt.write", "ckpt.manifest", "fs.open", "fs.list", "step.run",
     "device.probe", "prefetch.produce", "serve.enqueue", "serve.step",
-    "serve.decode_step", "serve.worker_crash", "serve.router_route",
+    "serve.prefill", "serve.decode_step", "serve.worker_crash",
+    "serve.router_route",
 })
 
 

@@ -216,15 +216,15 @@ def test_warmup_captures_and_steps_join(lm_params, default_log):
     marlin_program_roofline_frac for the active bucket."""
     from marlin_tpu import obs
     from marlin_tpu.serving import Request, ServeEngine
-    from marlin_tpu.serving.batcher import bucket_program_key
+    from marlin_tpu.serving.kvpool import paged_program_key
 
     with obs.MetricsServer(port=0) as srv:
         with ServeEngine(lm_params, HEADS, buckets=((8, 4),), max_batch=4,
                          max_wait_ms=0.0, queue_depth=32) as eng:
             eng.warmup()
-            key = bucket_program_key(lm_params, (8, 4), 4)
-            assert perf.get_program_costs().has("lm_decode_rows", key)
-            assert perf.get_program_costs().has("lm_prefill_slot", key)
+            key = paged_program_key(lm_params, (8, 4), 4, eng._page_len)
+            assert perf.get_program_costs().has("lm_decode_paged", key)
+            assert perf.get_program_costs().has("lm_prefill_paged", key)
             hs = [eng.submit(Request(prompt=[1, 2, 3], steps=3))
                   for _ in range(4)]
             eng.drain()
@@ -232,14 +232,14 @@ def test_warmup_captures_and_steps_join(lm_params, default_log):
             text = urllib.request.urlopen(srv.url, timeout=10).read().decode()
     rows = {(r["program"], r["key"]): r
             for r in perf.get_program_costs().rows()}
-    row = rows[("lm_decode_rows", key)]
+    row = rows[("lm_decode_paged", key)]
     assert row["calls"] >= 1 and row["flops"] > 0
     assert row["roofline_frac"] is not None  # CPU nominal peaks exist
     assert "marlin_program_roofline_frac{" in text
     # engine close emitted util snapshots: the analyzer's table works from
     # the JSONL alone
     out = analyze(default_log.read())
-    assert "== program utilization ==" in out and "lm_decode_rows" in out
+    assert "== program utilization ==" in out and "lm_decode_paged" in out
 
 
 # ------------------------------------------------------------ flight recorder

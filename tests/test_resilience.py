@@ -74,6 +74,9 @@ def _engine(params, **kw):
     kw.setdefault("max_batch", 4)
     kw.setdefault("max_wait_ms", 0.0)
     kw.setdefault("queue_depth", 512)
+    # ample page capacity: the soak queues ~500 requests at once and the
+    # page-unit admission charge must not become the gate under test
+    kw.setdefault("num_pages", 1024)
     return ServeEngine(params, HEADS, **kw)
 
 
@@ -87,16 +90,17 @@ def _ref(params, prompt, steps, heads=HEADS):
 # --------------------------------------------------------------- supervisor
 
 
-@pytest.mark.parametrize("rowlevel", [False, True],
-                         ids=["gang", "rowlevel"])
-def test_supervisor_recovers_worker_crash(params, rowlevel, tmp_path):
+@pytest.mark.parametrize("paged", [False, True], ids=["slab", "paged"])
+def test_supervisor_recovers_worker_crash(params, paged, tmp_path):
     """The crash-recovery invariant: a serve.worker_crash mid-stream kills
     the worker thread; the supervisor restarts it within the backoff
-    budget, live rows re-queue within their attempt budget, every request
-    reaches exactly one terminal ok Result, and greedy outputs are
-    bit-identical to uninterrupted lm_generate."""
+    budget, live rows re-queue within their attempt budget (page-unit
+    reservations carried across attempts on the paged backend; the pool is
+    dropped and rebuilt zeroed), every request reaches exactly one
+    terminal ok Result, and greedy outputs are bit-identical to
+    uninterrupted lm_generate."""
     log = EventLog(str(tmp_path / "serve.jsonl"))
-    eng = _engine(params, rowlevel=rowlevel, log=log)
+    eng = _engine(params, paged=paged, log=log)
     eng.warmup()
     sup = Supervisor(eng, backoff_s=0.005, poll_s=0.02, log=log)
     try:

@@ -2,15 +2,16 @@
 
 The acceptance scenario (test_acceptance_continuous_batching) drives 36
 concurrent requests across two shape buckets through :class:`ServeEngine` on
-an injectable clock — under BOTH schedulers (row-level slot-step, the
-default, and the gang fallback) — and asserts the subsystem's contracts:
-exactly one Result per request, per-row outputs bit-identical to the direct
-decode call (:func:`lm_generate` for row-level, :func:`lm_generate_batch`
-on the bucket shape for gang — greedy decode is composition-independent, so
-both references agree), deadline expiry surfaced (never silently dropped),
-and a bounded compile count (≤ 2 programs per bucket row-level, ≤ 1 gang —
-the conftest ``compile_count`` fixture). Everything runs greedy/seeded on
-the CPU mesh, so it is fully deterministic.
+an injectable clock — under BOTH KV backends (the paged pool, the default,
+and the dense slot slab, the PR 4 control) — and asserts the subsystem's
+contracts: exactly one Result per request, per-row outputs bit-identical to
+the direct :func:`lm_generate` call on the unpadded prompt (greedy decode
+is composition-independent), deadline expiry surfaced (never silently
+dropped), and a bounded compile count (≤ 2 programs per bucket slab, ≤ 3
+paged — prefill-chunk + decode + the shared page-copy; the conftest
+``compile_count`` fixture). Everything runs greedy/seeded on the CPU mesh,
+so it is fully deterministic. Paged-pool internals (alloc/refcount/COW/
+prefix cache/chunked-prefill resumability) live in tests/test_paging.py.
 """
 
 import threading
@@ -22,8 +23,8 @@ import pytest
 import jax
 
 from marlin_tpu.models import TransformerLM
-from marlin_tpu.models.transformer import (lm_decode_rows, lm_generate,
-                                           lm_generate_batch,
+from marlin_tpu.models.transformer import (lm_decode_paged, lm_decode_rows,
+                                           lm_generate, lm_prefill_paged,
                                            lm_prefill_slot)
 from marlin_tpu.serving import (
     STATUS_ERROR,
@@ -45,6 +46,7 @@ from marlin_tpu.utils.faults import FaultInjected, RaiseFault, Schedule
 
 HEADS = 2
 BUCKETS = ((8, 4), (16, 4))
+PAGE_LEN = 4  # small pages so every bucket is genuinely multi-page
 
 
 class FakeClock:
@@ -73,21 +75,12 @@ def _engine(params, **kw):
     kw.setdefault("max_batch", 4)
     kw.setdefault("max_wait_ms", 0.0)
     kw.setdefault("queue_depth", 64)
+    kw.setdefault("page_len", PAGE_LEN)
+    # ample page capacity: these tests exercise the queue-depth / explicit
+    # HBM gates, not pool sizing (test_paging.py covers page-unit admission
+    # and the auto-sized pool)
+    kw.setdefault("num_pages", 1024)
     return ServeEngine(params, HEADS, **kw)
-
-
-def _reference(params, prompt, steps_req, bucket):
-    """What the engine MUST produce for one request: lm_generate_batch called
-    directly on the request's bucket shape (greedy, so batch composition and
-    the PRNG key cannot change the row)."""
-    p, s = bucket
-    n = len(prompt)
-    padded = np.zeros((1, p), np.int32)
-    padded[0, :n] = prompt
-    out = np.asarray(lm_generate_batch(
-        params, padded, np.array([n], np.int32), jax.random.key(0),
-        heads=HEADS, max_len=p + s, steps=s))
-    return out[0, : n + steps_req]
 
 
 def _reference_single(params, prompt, steps_req, heads=HEADS):
@@ -152,72 +145,47 @@ def _stub_entry(priority=0, enq_t=0.0, bucket=(8, 4)):
     return types.SimpleNamespace(request=r, enq_t=enq_t, bucket=bucket)
 
 
-def test_batch_former_wait_and_priority():
-    f = BatchFormer(BUCKETS, max_batch=2, max_wait=1.0)
-    f.add(_stub_entry(priority=0, enq_t=0.0))
-    key, hint = f.next_batch(now=0.5)
-    assert key is None and hint == pytest.approx(0.5)   # not ripe yet
-    key, batch = f.next_batch(now=1.0)                  # max_wait reached
-    assert key[0] == (8, 4) and len(batch) == 1
-    # full batch dispatches immediately; higher priority rides first
-    for pri in (1, 5, 3):
-        f.add(_stub_entry(priority=pri, enq_t=2.0))
-    key, batch = f.next_batch(now=2.0)
-    assert [e.request.priority for e in batch] == [5, 3]
-    # force (the drain path) flushes the unripe leftover
-    key, batch = f.next_batch(now=2.0, force=True)
-    assert [e.request.priority for e in batch] == [1]
+def test_batch_former_priority_and_bucket_queues():
+    """The post-gang former: one priority-ordered FIFO per bucket. Higher
+    priority claims first, FIFO among equals, sampling knobs never
+    partition (they are per-row traced in the decode programs)."""
+    f = BatchFormer(BUCKETS, max_batch=2)
+    for pri, seed, temp in ((0, 1, 0.0), (5, 2, 0.7), (3, 1, 0.9)):
+        e = _stub_entry(priority=pri)
+        e.request.seed = seed
+        e.request.temperature = temp
+        f.add(e)
+    f.add(_stub_entry(priority=0, bucket=(16, 4)))
+    assert f.pending() == 4
+    assert f.pending_buckets() == {(8, 4), (16, 4)}
+    taken = f.take_for_bucket((8, 4), 2)
+    assert [e.request.priority for e in taken] == [5, 3]
+    assert f.take_for_bucket((99, 99), 4) == []
+    rest = f.take_all()
+    assert {e.bucket for e in rest} == {(8, 4), (16, 4)}
     assert f.pending() == 0
 
 
-def test_batch_former_groups_by_sampling_knobs():
-    f = BatchFormer(BUCKETS, max_batch=4, max_wait=0.0)
-    a, b = _stub_entry(), _stub_entry()
-    b.request.temperature = 0.7
-    f.add(a)
-    f.add(b)
-    key1, batch1 = f.next_batch(now=0.0)
-    key2, batch2 = f.next_batch(now=0.0)
-    assert len(batch1) == len(batch2) == 1
-    assert {key1[1], key2[1]} == {0.0, 0.7}
-
-
-def test_batch_former_sampled_requests_never_share_across_seeds():
-    """A batch decodes under ONE PRNG key, so a sampled request must only
-    ride with same-seed peers — different seeds sharing a batch would
-    silently hand one request the other's stream. Greedy requests ignore
-    the key: seeds must NOT fragment their batches."""
-    f = BatchFormer(BUCKETS, max_batch=4, max_wait=0.0)
-    for seed in (1, 2, 1):
-        e = _stub_entry()
-        e.request.temperature = 0.7
-        e.request.seed = seed
+def test_batch_former_fifo_among_equal_priority():
+    f = BatchFormer(BUCKETS, max_batch=4)
+    entries = [_stub_entry(priority=1) for _ in range(3)]
+    for e in entries:
         f.add(e)
-    _, b1 = f.next_batch(now=0.0)
-    _, b2 = f.next_batch(now=0.0)
-    assert sorted(len(b) for b in (b1, b2)) == [1, 2]
-    # greedy: different seeds, one batch
-    for seed in (1, 2):
-        e = _stub_entry()
-        e.request.seed = seed
-        f.add(e)
-    _, b3 = f.next_batch(now=0.0)
-    assert len(b3) == 2
+    assert f.take_for_bucket((8, 4), 3) == entries  # arrival order kept
 
 
 # ------------------------------------------------------------- engine layer
 
 
-@pytest.mark.parametrize("rowlevel", [False, True],
-                         ids=["gang", "rowlevel"])
-def test_acceptance_continuous_batching(params, rowlevel):
+@pytest.mark.parametrize("paged", [False, True], ids=["slab", "paged"])
+def test_acceptance_continuous_batching(params, paged):
     """The tentpole acceptance: >= 32 concurrent requests, >= 2 buckets,
     deterministic clock — exactly one Result each, per-row bit-identical to
-    the direct decode call (lm_generate for the row-level scheduler,
-    lm_generate_batch on the bucket shape for gang; greedy agrees across
-    both), expired deadlines surfaced, drain() completes in-flight work,
-    and a bounded compile count (<= 2 programs per bucket row-level via the
-    prefill/decode-step caches, <= 1 gang)."""
+    the direct lm_generate call on the unpadded prompt (both KV backends:
+    the paged pool and the dense-slab control), expired deadlines surfaced,
+    drain() completes in-flight work, and a bounded compile count (<= 2
+    programs per bucket slab; <= 2 per bucket paged plus the one shared
+    page-copy program — <= 3 total per bucket, for any knob mix)."""
     clock = FakeClock()
     rng = np.random.default_rng(4)
     reqs = []
@@ -229,17 +197,18 @@ def test_acceptance_continuous_batching(params, rowlevel):
     expired = [Request(prompt=[1, 2], steps=2, deadline=-1.0)
                for _ in range(4)]
 
-    if rowlevel:
+    if paged:
+        probes = [getattr(f, "_cache_size", None)
+                  for f in (lm_prefill_paged, lm_decode_paged)]
+        per_bucket = 2  # + the shared page-copy program, counted below
+    else:
         probes = [getattr(f, "_cache_size", None)
                   for f in (lm_prefill_slot, lm_decode_rows)]
         per_bucket = 2
-    else:
-        probes = [getattr(lm_generate_batch, "_cache_size", None)]
-        per_bucket = 1
     probes = [p for p in probes if p is not None]
     before = sum(p() for p in probes)
 
-    eng = _engine(params, clock=clock, rowlevel=rowlevel)
+    eng = _engine(params, clock=clock, paged=paged)
     try:
         handles = {}
         lock = threading.Lock()
@@ -276,15 +245,14 @@ def test_acceptance_continuous_batching(params, rowlevel):
             assert grew <= per_bucket * len(BUCKETS), \
                 f"recompiled: {grew} programs for {BUCKETS}"
 
-        # per-row bit-identical to the direct call: the gang reference is
-        # the fused bucket-shape program; the row-level bar is lm_generate
-        # on the unpadded prompt itself
+        # per-row bit-identical to the direct call: lm_generate on the
+        # unpadded prompt itself — regardless of bucket padding, page
+        # boundaries, chunked prefill, or co-resident rows
         for r in reqs:
             res = results[r.rid]
             assert res.status == STATUS_OK, (r.rid, res.reason)
             bucket = pick_bucket(len(r.prompt), r.steps, BUCKETS)
-            ref = (_reference_single(params, r.prompt, r.steps) if rowlevel
-                   else _reference(params, r.prompt, r.steps, bucket))
+            ref = _reference_single(params, r.prompt, r.steps)
             assert res.tokens.tolist() == ref.tolist(), r.rid
             assert res.metrics["bucket"] == bucket
             assert res.metrics["total_s"] >= 0.0
@@ -373,10 +341,11 @@ def test_close_retires_queued_with_shutting_down(params):
     assert r.status == STATUS_SHUTTING_DOWN
 
 
-def test_serve_step_fault_fails_batch_and_engine_recovers(params):
-    """Chaos: a serve.step fault kills one batch mid-flight — its requests
-    get error Results (never dropped), and the engine keeps serving."""
-    with _engine(params) as eng:
+def test_serve_step_fault_fails_request_and_engine_recovers(params):
+    """Chaos: a serve.step fault kills one slab prefill mid-flight — the
+    request gets an error Result (never dropped), and the engine keeps
+    serving (the paged analog, serve.prefill, lives in test_paging.py)."""
+    with _engine(params, paged=False) as eng:
         with faults.injected("serve.step", RaiseFault(times=1)):
             bad = eng.submit(Request(prompt=[1, 2], steps=2))
             r = bad.result(timeout=60)
@@ -401,9 +370,10 @@ def test_serve_enqueue_fault_propagates_to_caller(params):
 
 
 def test_metrics_eventlog_records(params, tmp_path):
-    """Gang scheduler event stream: batch records with occupancy."""
+    """Paged engine event stream: prefill/step/page records with occupancy
+    and pool accounting."""
     log = EventLog(str(tmp_path / "serve.jsonl"))
-    with _engine(params, log=log, rowlevel=False) as eng:
+    with _engine(params, log=log) as eng:
         hs = [eng.submit(Request(prompt=[1, 2, 3], steps=2))
               for _ in range(3)]
         for h in hs:
@@ -412,41 +382,22 @@ def test_metrics_eventlog_records(params, tmp_path):
     recs = [r for r in log.read() if r["kind"] == "serve"]
     evs = [r["ev"] for r in recs]
     assert evs.count("enqueue") == 3 and evs.count("reject") == 1
-    batches = [r for r in recs if r["ev"] == "batch"]
-    assert batches and all(0.0 < b["occupancy"] <= 1.0 for b in batches)
-    assert sum(b["rows"] for b in batches) == 3
+    steps = [r for r in recs if r["ev"] == "step"]
+    assert steps and all(0.0 < s["occupancy"] <= 1.0 for s in steps)
+    prefills = [r for r in recs if r["ev"] == "prefill"]
+    assert len(prefills) == 3  # one chunk each (3-token prompts)
+    assert all(p["chunk"][0] == 0 and p["chunk"][1] == 3 for p in prefills)
+    assert sum(p["new_tokens"] for p in prefills) == 3  # final chunks only
+    pages = [r for r in recs if r["ev"] == "page"]
+    allocs = [r for r in pages if r["action"] == "alloc"]
+    frees = [r for r in pages if r["action"] == "free"]
+    assert len(allocs) == 3 and len(frees) == 3
+    assert all(0 < r["used"] <= r["total"] for r in allocs)
+    assert sum(r["pages"] for r in allocs) == sum(r["pages"] for r in frees)
     results = [r for r in recs if r["ev"] == "result" and r["status"] == "ok"]
     assert len(results) == 3
     for r in results:
-        assert r["ttft_s"] == r["total_s"] >= r["queue_s"] >= 0.0
-
-
-def test_sampling_knobs_partition_batches(params):
-    """Gang scheduler: different sampling knobs never share a batch; a
-    traced temperature difference costs a second dispatch, not a second
-    compile."""
-    probe = getattr(lm_generate_batch, "_cache_size", None)
-    eng = _engine(params, start=False, rowlevel=False)
-    try:
-        cold = [eng.submit(Request(prompt=[1, 2], steps=2))
-                for _ in range(2)]
-        hot = [eng.submit(Request(prompt=[1, 2], steps=2, temperature=0.7,
-                                  seed=3)) for _ in range(2)]
-        before = probe() if probe else None
-        eng.start()
-        eng.drain()
-        for h in cold + hot:
-            assert h.result(timeout=1).status == STATUS_OK
-        assert eng.metrics.snapshot()["batches"] == 2
-        # greedy rows are key/temperature-independent: cold rows must equal
-        # the greedy reference even though a sampled group ran alongside
-        ref = _reference(params, [1, 2], 2, (8, 4))
-        for h in cold:
-            assert h.result().tokens.tolist() == ref.tolist()
-        if probe:
-            assert probe() - before <= 1  # temperature is traced, not static
-    finally:
-        eng.close()
+        assert r["total_s"] >= r["ttft_s"] >= r["queue_s"] >= 0.0
 
 
 def test_priority_orders_dispatch(params, tmp_path):
@@ -471,15 +422,13 @@ def test_priority_orders_dispatch(params, tmp_path):
     assert set(order[:4]) == high_rids, order
 
 
-@pytest.mark.parametrize("rowlevel", [False, True],
-                         ids=["gang", "rowlevel"])
-def test_warmup_then_traffic_compiles_nothing(params, compile_count,
-                                              rowlevel):
-    """warmup() pays every bucket's compile up front — one fused program
-    per bucket gang, the prefill/decode-step pair per bucket row-level —
-    and traffic afterwards adds ZERO XLA compiles (the promoted
-    compile-bound guard from tests/conftest.py)."""
-    with _engine(params, rowlevel=rowlevel) as eng:
+@pytest.mark.parametrize("paged", [False, True], ids=["slab", "paged"])
+def test_warmup_then_traffic_compiles_nothing(params, compile_count, paged):
+    """warmup() pays every bucket's compile up front — the prefill/decode
+    pair per bucket (plus the shared page-copy program paged) — and
+    traffic afterwards adds ZERO XLA compiles (the promoted compile-bound
+    guard from tests/conftest.py)."""
+    with _engine(params, paged=paged) as eng:
         assert eng.warmup() == len(BUCKETS)
         with compile_count() as c:
             hs = [eng.submit(Request(prompt=[1] * n, steps=2))
@@ -538,7 +487,7 @@ def test_drain_vs_concurrent_submit_race(params):
     assert eng._queue.bytes_in_flight == 0
 
 
-# ---------------------------------------------------- row-level scheduler
+# ------------------------- row-level scheduling (paged default backend)
 
 
 def test_rowlevel_step_events_and_slot_refill(params, tmp_path):
@@ -694,16 +643,23 @@ def test_rowlevel_decode_step_fault_fails_only_live_rows(params):
     assert eng._queue.bytes_in_flight == 0
 
 
-@pytest.mark.parametrize("rowlevel", [False, True],
-                         ids=["gang", "rowlevel"])
-def test_expiring_burst_releases_admission_budget(params, rowlevel):
+@pytest.mark.parametrize("paged", [False, True], ids=["slab", "paged"])
+def test_expiring_burst_releases_admission_budget(params, paged):
     """Regression (admission accounting): a burst of requests that all
     expire — some at submit, some at dispatch — must release every byte of
-    the in-flight KV budget on retirement, or admission wedges forever."""
+    the in-flight KV budget on retirement, or admission wedges forever.
+    The paged leg charges in PAGE units (request_pages x kv_page_bytes) —
+    the page-reservation mirror of the PR 4 byte-unit regression."""
     clock = FakeClock()
-    eng = _engine(params, clock=clock, start=False, rowlevel=rowlevel,
-                  hbm_budget_bytes=10 * bucket_kv_bytes(params, HEADS,
-                                                        (8, 4)))
+    if paged:
+        from marlin_tpu.models.planner import kv_page_bytes, request_pages
+
+        unit = (request_pages(2, 2, PAGE_LEN)
+                * kv_page_bytes(params, HEADS, PAGE_LEN))
+    else:
+        unit = bucket_kv_bytes(params, HEADS, (8, 4))
+    eng = _engine(params, clock=clock, start=False, paged=paged,
+                  hbm_budget_bytes=10 * unit)
     try:
         at_submit = [eng.submit(Request(prompt=[1, 2], steps=2,
                                         deadline=-1.0)) for _ in range(3)]
@@ -736,8 +692,13 @@ def test_crash_retry_releases_admission_budget_exactly_once(params):
     burst guarantee): a request parked between attempts must hold EXACTLY
     its one admission reservation — never double-charged by the re-queue,
     and fully released on its final retirement whichever attempt serves
-    it. Covers the decode-fault retry and the exhausted-budget error."""
-    cost = bucket_kv_bytes(params, HEADS, (8, 4))
+    it. Covers the decode-fault retry and the exhausted-budget error.
+    Runs the (default) paged backend, so the reservation under test is the
+    page-unit charge carried across attempts."""
+    from marlin_tpu.models.planner import kv_page_bytes, request_pages
+
+    cost = (request_pages(2, 3, PAGE_LEN)
+            * kv_page_bytes(params, HEADS, PAGE_LEN))
     eng = _engine(params, max_batch=2, start=False,
                   hbm_budget_bytes=10 * cost)
     try:
@@ -793,8 +754,9 @@ def test_aot_compile_buckets_reports_hbm(params):
 @pytest.mark.slow
 def test_serving_soak_with_chaos(params):
     """Multi-minute-class soak: concurrent submitters, probabilistic
-    serve.step chaos, ragged sizes — every request resolves, counters add
-    up, nothing leaks (conftest checks threads + fault registry)."""
+    prefill + decode-step chaos, ragged sizes — every request resolves,
+    counters add up, nothing leaks (conftest checks threads + fault
+    registry)."""
     rng = np.random.default_rng(11)
     n_threads, per_thread = 4, 40
     eng = _engine(params, queue_depth=n_threads * per_thread)
@@ -812,8 +774,11 @@ def test_serving_soak_with_chaos(params):
 
     try:
         with faults.injected(
-                "serve.step",
-                RaiseFault(times=-1, schedule=Schedule(seed=3, rate=0.05))):
+                "serve.prefill",
+                RaiseFault(times=-1, schedule=Schedule(seed=3, rate=0.05))), \
+             faults.injected(
+                "serve.decode_step",
+                RaiseFault(times=-1, schedule=Schedule(seed=4, rate=0.02))):
             threads = [threading.Thread(target=submitter, args=(100 + i,))
                        for i in range(n_threads)]
             for t in threads:

@@ -1,19 +1,39 @@
-# Repo-level entry points. `make check` is the default: the serving gate
-# (tier-1 serving + resilience tests, then tools/bench_compare.py over the
-# BENCH_ALL.json serve_* records), the bench-gate selftest, and the
-# obs-report smoke — see tools/Makefile for the individual targets and
-# their knobs (SERVE_BASE/SERVE_NEW, BASE/NEW).
+# Repo-level entry points. `make check` is the default: the native-library
+# build (fails loudly when the toolchain is missing — a silent fallback to
+# the pure-Python data plane is a 100x perf bug that looks like a pass),
+# then the serving gate (tier-1 serving + resilience tests, then
+# tools/bench_compare.py over the BENCH_ALL.json serve_* records), the
+# out-of-core data-plane gate, the bench-gate selftest, and the obs-report
+# smoke — see tools/Makefile for the individual targets and their knobs
+# (SERVE_BASE/SERVE_NEW, OOC_BASE/OOC_NEW, BASE/NEW).
 
 .DEFAULT_GOAL := check
 
-check:
+check: native
 	$(MAKE) -C tools check
+
+# both native IO libraries (libmarlin_textio.so, libmarlin_chunkstore.so)
+native:
+	$(MAKE) -C marlin_tpu/native
 
 serve-gate:
 	$(MAKE) -C tools serve-gate
+
+ooc-gate:
+	$(MAKE) -C tools ooc-gate
+
+# build the .mchunk sidecar for a data file (native binary data plane):
+#   make chunkstore SRC=path/to/matrix.txt
+# auto-detects text vs idx3 from the name; more knobs via
+#   python -m marlin_tpu.io.chunkstore build --help
+chunkstore: native
+	@test -n "$(SRC)" || { echo "usage: make chunkstore SRC=<file>"; exit 2; }
+	env JAX_PLATFORMS=cpu python -c "\
+	from marlin_tpu.io.chunkstore import _main; \
+	import sys; sys.exit(_main(['build', '$(SRC)']))"
 
 tier1:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: check serve-gate tier1
+.PHONY: check native serve-gate ooc-gate chunkstore tier1

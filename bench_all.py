@@ -202,6 +202,78 @@ def config4():
            2 * chunk * cols**2 / dev_dt / 1e9, "GFLOP/s",
            f"{dev_dt * 1e3:.1f} ms per {chunk}-row rank-update, data resident")
 
+    _config4_file_legs()
+
+
+def _config4_file_legs():
+    """The data-plane A/B at the config-4 shape, fed from DISK: the same
+    streamed Gramian with chunks produced by (a) the Python text parser
+    (``MARLIN_BENCH_NATIVE_PLANE=0`` runs only this control leg) and (b) the
+    native chunkstore sidecar (``=1`` only this; unset runs both, text
+    first). The gap between the legs is what marlin_tpu/io/chunkstore.py
+    exists to close. Each record's detail carries the producer-stage
+    breakdown (produce = parse / mcs_read+convert, transfer = device_put,
+    stall = un-overlapped producer latency the consumer actually waited out,
+    compute, drain — utils/profiling.StageTimes). MARLIN_BENCH_FILE_ROWS
+    sizes the file (default 65536 x 512 — ~300 MB of text, tractable for
+    the Python-parser control; GFLOP/s is row-count invariant here)."""
+    from marlin_tpu import native
+    from marlin_tpu.io.chunkstore import transcode_text
+    from marlin_tpu.io.text import load_matrix_file_out_of_core
+    from marlin_tpu.parallel import streamed_gramian
+    from marlin_tpu.utils.profiling import StageTimes
+
+    rows = int(os.environ.get("MARLIN_BENCH_FILE_ROWS", 65536))
+    cols = 512
+    chunk = min(rows, 8192)
+    plane = os.environ.get("MARLIN_BENCH_NATIVE_PLANE", "")
+    legs = {"0": ("text",), "1": ("native",)}.get(plane, ("text", "native"))
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    subprocess.run(["make", "-s", "-C", tools], check=True)
+    gflop = 2 * rows * cols**2 / 1e9
+    speeds = {}
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "tall.txt")
+        with open(path, "w") as f:
+            subprocess.run([os.path.join(tools, "genmat"), str(rows),
+                            str(cols), "7"], stdout=f, check=True)
+        log(f"config4 file legs: {os.path.getsize(path) / 1e6:.0f} MB text, "
+            f"legs={legs}")
+        # warm the chunk programs (full + tail shapes) so neither leg pays a
+        # compile inside its timed pass
+        streamed_gramian(iter([np.zeros((chunk, cols), np.float64),
+                               np.zeros((rows % chunk or chunk, cols),
+                                        np.float64)]))
+        for leg in legs:
+            if leg == "native":
+                # the A/B is meaningless if the "native" leg silently fell
+                # back to the text parser — refuse rather than mislabel
+                if not native.chunkstore_available():
+                    raise RuntimeError("native chunkstore library "
+                                       f"unavailable: {native.build_error()}")
+                t0 = time.perf_counter()
+                transcode_text(path, chunk_rows=chunk)
+                build_s = time.perf_counter() - t0
+                ooc = load_matrix_file_out_of_core(path, chunk_rows=chunk)
+                assert "chunkstore" in repr(ooc), "sidecar not auto-selected"
+                note = f"sidecar built in {build_s:.1f} s (one-time); "
+            else:
+                ooc = load_matrix_file_out_of_core(path, chunk_rows=chunk,
+                                                   chunkstore=False)
+                note = "Python text parse every pass; "
+            stats = StageTimes()
+            t0 = time.perf_counter()
+            g = ooc.gramian(stats=stats)
+            dt = time.perf_counter() - t0
+            assert g.shape == (cols, cols)
+            speeds[leg] = gflop / dt
+            if leg == "native" and "text" in speeds:
+                note += (f"{speeds['native'] / speeds['text']:.1f}x the text "
+                         "plane; ")
+            record(f"4_file_{rows}x512_gramian_{leg}_plane", gflop / dt,
+                   "GFLOP/s", f"{dt:.1f} s end-to-end from disk "
+                   f"[{note}stages: {stats.summary()}]")
+
 
 def config5():
     import marlin_tpu as mt
@@ -969,6 +1041,10 @@ def main():
         "bf16": lambda: _dense_config(20000, 10, "3_dense_20000_bf16",
                                       precision="default"),
         "4": config4,
+        # the file-fed data-plane A/B alone (it also runs at the tail of
+        # config 4): re-measure the text-vs-chunkstore legs without the
+        # 8 GB synthetic-generation legs in front
+        "4file": _config4_file_legs,
         "5": config5,
         "lu": config_lu,
         "chol": config_cholesky,

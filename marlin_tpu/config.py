@@ -86,6 +86,20 @@ class MarlinConfig:
     # producer blocks before device_put when the budget is full (at least one
     # chunk is always allowed through). 0 = unbounded (depth alone bounds it).
     prefetch_hbm_budget_bytes: int = 2 << 30
+    # --- native data plane (io/chunkstore.py) --------------------------------
+    # Reader-pool threads per chunk-store read: the native mcs_read fans the
+    # touched chunks (CRC validation + dtype conversion) over this many
+    # std::threads, all outside the GIL. 1 = serial in-call reads.
+    data_plane_threads: int = 4
+    # Staging dtype chunk-store reads convert into natively (None = the
+    # stored dtype). "bfloat16" makes chunks surface pre-compressed, so the
+    # streamed ops' host-side transfer cast is a no-op and H2D bytes halve —
+    # direct-bf16 staging off disk.
+    data_plane_dtype: str | None = None
+    # CRC32C-validate every touched chunk on read. Costs one pass over the
+    # bytes (still far cheaper than parsing text); turn off only for
+    # throughput experiments on trusted files.
+    data_plane_verify: bool = True
     # --- serving engine (serving/) -------------------------------------------
     # Slot rows per dispatched batch. Every batch is padded to exactly this
     # width (free slots carry dummy rows), so the compiled program count is

@@ -13,6 +13,17 @@ from .mnist import (  # noqa: F401
     iter_mnist_image_chunks,
     mnist_images_out_of_core,
 )
+from .chunkstore import (  # noqa: F401
+    ChunkStore,
+    ChunkStoreWriter,
+    ChunkstoreError,
+    ChunkstoreCorruptError,
+    write_chunkstore,
+    transcode_text,
+    transcode_idx,
+    sidecar_path,
+    open_sidecar,
+)
 from .checkpoint import (  # noqa: F401
     save_checkpoint,
     load_checkpoint,

@@ -53,11 +53,31 @@ def iter_mnist_image_chunks(path: str, chunk_rows: int = 1 << 14):
             remaining -= take
 
 
-def mnist_images_out_of_core(path: str, chunk_rows: int = 1 << 14):
+def mnist_images_out_of_core(path: str, chunk_rows: int = 1 << 14,
+                             chunkstore: bool | None = None):
     """:class:`~marlin_tpu.matrix.out_of_core.OutOfCoreMatrix` over an idx3
     images file. The source is a re-iterable callable, so every streamed op
-    (multiply/gramian/sum) makes its own chunked pass over the file."""
+    (multiply/gramian/sum) makes its own chunked pass over the file.
+
+    ``chunkstore`` as in :func:`~marlin_tpu.io.text.
+    load_matrix_file_out_of_core`: None auto-selects a fresh
+    ``<path>.mchunk`` sidecar (native binary reads, no per-pass idx decode +
+    ``/255`` normalization), True builds-and-requires it, False forces the
+    idx path. The sidecar stores the normalized float32 rows, bit-identical
+    to :func:`iter_mnist_image_chunks`."""
     from ..matrix.out_of_core import OutOfCoreMatrix
+
+    if chunkstore is not False:
+        from .chunkstore import open_sidecar, transcode_idx
+
+        store = open_sidecar(path)
+        if store is None and chunkstore is True:
+            # just built -> fresh by construction (see text.py counterpart)
+            from .chunkstore import ChunkStore
+
+            store = ChunkStore(transcode_idx(path, chunk_rows=chunk_rows))
+        if store is not None:
+            return OutOfCoreMatrix(store, chunk_rows=chunk_rows)
 
     with _open(path) as f:
         n, dim = _read_idx3_header(f, path)

@@ -133,12 +133,38 @@ def iter_matrix_file_chunks(path: str, chunk_rows: int = 4096):
         yield np.stack(buf)
 
 
-def load_matrix_file_out_of_core(path: str, chunk_rows: int = 4096):
+def load_matrix_file_out_of_core(path: str, chunk_rows: int = 4096,
+                                 chunkstore: bool | None = None):
     """:class:`~marlin_tpu.matrix.out_of_core.OutOfCoreMatrix` over a
     row-format text file: one cheap line-counting pass for the shape, then
     each streamed op makes its own chunked parsing pass (re-iterable
-    callable source)."""
+    callable source).
+
+    ``chunkstore`` — the native data plane (io/chunkstore.py). None (the
+    default) auto-selects: when a fresh ``<path>.mchunk`` sidecar exists and
+    the native library is built, streamed ops read mmap'd CRC'd binary
+    chunks instead of re-parsing text every pass (build the sidecar with
+    ``python -m marlin_tpu.io.chunkstore build``). True requires the
+    sidecar (built on the spot when missing); False forces the text path."""
     from ..matrix.out_of_core import OutOfCoreMatrix
+
+    if chunkstore is not False:
+        from .chunkstore import open_sidecar, transcode_text
+
+        local = local_path(path)
+        store = open_sidecar(local) if local is not None else None
+        if store is None and chunkstore is True:
+            if local is None:
+                raise ValueError(
+                    f"chunkstore path needs a local file, got {path!r}")
+            # just built -> fresh by construction; open directly rather than
+            # through open_sidecar's mtime heuristic (which is for trusting
+            # a PRE-existing sidecar, and would re-reject under clock skew)
+            from .chunkstore import ChunkStore
+
+            store = ChunkStore(transcode_text(local, chunk_rows=chunk_rows))
+        if store is not None:
+            return OutOfCoreMatrix(store, chunk_rows=chunk_rows)
 
     nrows, ncols = 0, 0
     for lineno, line in enumerate(_iter_lines(path), start=1):

@@ -27,7 +27,20 @@ class OutOfCoreMatrix:
         """``source``: a 2-D ndarray/memmap, or a zero-arg callable returning a
         fresh iterator of row-chunk ndarrays (callables must be re-iterable so
         multiple operations can each make a full pass)."""
-        if callable(source):
+        self._store = None
+        if hasattr(source, "iter_chunks") and hasattr(source, "read_rows"):
+            # a ChunkStore (io/chunkstore.py): the native data plane. Reads
+            # happen at THIS matrix's chunk_rows (native scatter/gather —
+            # on-disk chunk size is a file property, not a streaming one),
+            # and slice_rows becomes a true random access instead of a scan.
+            if shape is not None and tuple(shape) != tuple(source.shape):
+                raise ValueError(
+                    f"shape {tuple(shape)} contradicts the store's "
+                    f"{tuple(source.shape)}")
+            self._store = source
+            self._source = lambda: self._store.iter_chunks(self.chunk_rows)
+            self._shape = tuple(source.shape)
+        elif callable(source):
             if shape is None:
                 raise ValueError("shape is required for a callable chunk source")
             self._source = source
@@ -102,6 +115,12 @@ class OutOfCoreMatrix:
 
     def slice_rows(self, start: int, stop: int) -> np.ndarray:
         """Materialize a host row range [start, stop)."""
+        if self._store is not None:
+            start = max(start, 0)
+            stop = min(stop, self._shape[0])
+            if stop <= start:
+                return np.zeros((0, self.num_cols()))
+            return self._store.read_rows(start, stop - start)
         if self._source is None:
             return np.asarray(self._array[start:stop])
         out, pos = [], 0
@@ -133,5 +152,10 @@ class OutOfCoreMatrix:
         return DenseVecMatrix.from_array(buf, mesh)
 
     def __repr__(self):
-        kind = "callable" if self._source is not None else type(self._array).__name__
+        if self._store is not None:
+            kind = "chunkstore"
+        elif self._source is not None:
+            kind = "callable"
+        else:
+            kind = type(self._array).__name__
         return f"OutOfCoreMatrix(shape={self._shape}, source={kind}, chunk_rows={self.chunk_rows})"

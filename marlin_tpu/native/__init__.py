@@ -1,10 +1,19 @@
-"""ctypes bindings for the native text-IO library, with auto-build.
+"""ctypes bindings for the native IO libraries, with auto-build.
 
 The reference's data loading is Spark-JVM-side (MTUtils loaders); the
-TPU-native runtime keeps the data plane in C++ (textio.cpp) and binds it here
-via ctypes — no pybind11 dependency. If the shared object is missing, we try
-one `make` (the toolchain is a build-time requirement, not runtime), and fall
-back to the pure-Python parser in marlin_tpu.io.text otherwise.
+TPU-native runtime keeps the data plane in C++ and binds it here via ctypes —
+no pybind11 dependency. Two libraries:
+
+- ``libmarlin_textio.so``   — row-text parser/writer (textio.cpp)
+- ``libmarlin_chunkstore.so`` — MarlinChunk binary container (chunkstore.cpp),
+  the mmap'd data plane behind marlin_tpu.io.chunkstore
+
+If a shared object is missing we try one ``make`` (the toolchain is a
+build-time requirement, not runtime) and fall back to the pure-Python paths
+otherwise — but never *silently*: a failed build emits a one-time
+``RuntimeWarning`` carrying the captured make stderr, and ``build_error()``
+exposes it so tests and the bench harness can assert which path actually ran
+(a quietly-shadowed native plane is a 100x perf bug that looks like a pass).
 """
 
 from __future__ import annotations
@@ -12,73 +21,175 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import warnings
 
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "libmarlin_textio.so")
+_CHUNK_SO = os.path.join(_HERE, "libmarlin_chunkstore.so")
 _lib = None
+_chunk_lib = None
 _tried_build = False
+_build_error: str | None = None
+_warned = False
+
+
+def _run_make() -> None:
+    """One ``make`` over native/; capture failure text into ``_build_error``.
+
+    make no-ops when the .so files are newer than the sources and rebuilds
+    after a .cpp/.h edit (a stale binary would silently shadow fixes
+    otherwise). A missing toolchain or compile error lands in
+    ``_build_error`` — surfaced by :func:`build_error` and warned once in
+    :func:`_load`.
+    """
+    global _tried_build, _build_error
+    if _tried_build:
+        return
+    _tried_build = True
+    try:
+        proc = subprocess.run(["make", "-s", "-C", _HERE],
+                              capture_output=True, timeout=120, text=True)
+    except Exception as e:  # make missing, timeout, ...
+        _build_error = f"{type(e).__name__}: {e}"
+        return
+    if proc.returncode != 0:
+        err = (proc.stderr or proc.stdout or "").strip()
+        _build_error = (f"make exited {proc.returncode}: "
+                        f"{err or '(no output)'}")
+
+
+def build_error() -> str | None:
+    """Why the last native build attempt failed, or None.
+
+    None means either the build succeeded or no build was attempted yet
+    (nothing has called into the native layer). Tests and bench use this to
+    assert the native path genuinely ran rather than being silently shadowed
+    by the pure-Python fallback.
+    """
+    return _build_error
+
+
+def _warn_once(missing: str) -> None:
+    global _warned
+    if _warned or _build_error is None:
+        return
+    _warned = True
+    warnings.warn(
+        f"marlin_tpu native build failed ({missing} unavailable; falling "
+        f"back to the pure-Python data plane, expect ~100x slower IO): "
+        f"{_build_error}",
+        RuntimeWarning, stacklevel=3)
 
 
 def _load():
-    global _lib, _tried_build
+    global _lib
     if _lib is not None:
         return _lib
-    if not _tried_build:
-        # always let make decide — it no-ops when the .so is newer than the
-        # source, and rebuilds after a textio.cpp edit (a stale binary would
-        # silently shadow fixes otherwise)
-        _tried_build = True
-        try:
-            subprocess.run(["make", "-s", "-C", _HERE],
-                           capture_output=True, timeout=120)
-        except Exception:
-            pass
-    if os.path.exists(_SO):
-        lib = ctypes.CDLL(_SO)
-        lib.mt_count_matrix.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.mt_count_matrix.restype = ctypes.c_int
-        lib.mt_load_matrix.argtypes = [
-            ctypes.c_char_p,
-            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-            ctypes.c_int64,
-            ctypes.c_int64,
-        ]
-        lib.mt_load_matrix.restype = ctypes.c_int
-        lib.mt_save_matrix.argtypes = [
-            ctypes.c_char_p,
-            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-            ctypes.c_int64,
-            ctypes.c_int64,
-        ]
-        lib.mt_save_matrix.restype = ctypes.c_int
-        lib.mt_save_coo.argtypes = [
-            ctypes.c_char_p,
-            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-            ctypes.c_int64,
-        ]
-        lib.mt_save_coo.restype = ctypes.c_int
-        lib.mt_save_coo_f32.argtypes = [
-            ctypes.c_char_p,
-            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
-            ctypes.c_int64,
-        ]
-        lib.mt_save_coo_f32.restype = ctypes.c_int
-        _lib = lib
+    _run_make()
+    if not os.path.exists(_SO):
+        _warn_once(os.path.basename(_SO))
+        return None
+    lib = ctypes.CDLL(_SO)
+    lib.mt_count_matrix.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.mt_count_matrix.restype = ctypes.c_int
+    lib.mt_load_matrix.argtypes = [
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.mt_load_matrix.restype = ctypes.c_int
+    lib.mt_save_matrix.argtypes = [
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.mt_save_matrix.restype = ctypes.c_int
+    lib.mt_save_coo.argtypes = [
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+    ]
+    lib.mt_save_coo.restype = ctypes.c_int
+    lib.mt_save_coo_f32.argtypes = [
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+    ]
+    lib.mt_save_coo_f32.restype = ctypes.c_int
+    _lib = lib
     return _lib
+
+
+def _load_chunkstore():
+    """Bind libmarlin_chunkstore.so; None (with the one-time warning) if the
+    build failed. ctypes releases the GIL for the duration of every call, so
+    mcs_read's parse/verify/convert runs truly parallel to Python."""
+    global _chunk_lib
+    if _chunk_lib is not None:
+        return _chunk_lib
+    _run_make()
+    if not os.path.exists(_CHUNK_SO):
+        _warn_once(os.path.basename(_CHUNK_SO))
+        return None
+    lib = ctypes.CDLL(_CHUNK_SO)
+    lib.mcs_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.mcs_crc32c.restype = ctypes.c_uint32
+    lib.mcs_writer_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.mcs_writer_open.restype = ctypes.c_void_p
+    lib.mcs_writer_append.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+    ]
+    lib.mcs_writer_append.restype = ctypes.c_int
+    lib.mcs_writer_close.argtypes = [ctypes.c_void_p]
+    lib.mcs_writer_close.restype = ctypes.c_int
+    lib.mcs_writer_abort.argtypes = [ctypes.c_void_p]
+    lib.mcs_writer_abort.restype = None
+    lib.mcs_open.argtypes = [ctypes.c_char_p,
+                             ctypes.POINTER(ctypes.c_int32)]
+    lib.mcs_open.restype = ctypes.c_void_p
+    lib.mcs_info.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.mcs_info.restype = ctypes.c_int
+    lib.mcs_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.mcs_read.restype = ctypes.c_int
+    lib.mcs_close.argtypes = [ctypes.c_void_p]
+    lib.mcs_close.restype = None
+    lib.mcs_from_text.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.mcs_from_text.restype = ctypes.c_int
+    _chunk_lib = lib
+    return _chunk_lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def chunkstore_available() -> bool:
+    return _load_chunkstore() is not None
 
 
 def load_matrix_text(path: str) -> np.ndarray | None:

@@ -25,57 +25,32 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "parse_common.h"
+
 namespace {
 
-inline const char* skip_seps(const char* p, const char* end) {
-  // the reference's separator rule: ",\s?|\s+"
-  while (p < end && (*p == ',' || *p == ' ' || *p == '\t' || *p == '\r')) ++p;
-  return p;
+using marlin_native::FileBuf;
+using marlin_native::parse_value;
+using marlin_native::skip_seps;
+
+// Shortest-round-trip value formatter. FP to_chars where libstdc++ has it
+// (GCC 11+); otherwise printf with the dtype's round-trip precision (%.17g
+// f64 / %.9g f32 — longer than shortest for some values, still exact).
+inline char* format_value(char* p, char* cap, double v) {
+#if defined(__cpp_lib_to_chars)
+  return std::to_chars(p, cap, v).ptr;
+#else
+  return p + std::snprintf(p, cap - p, "%.17g", v);
+#endif
 }
 
-// Fast float parse: std::from_chars (Eisel-Lemire) is correctly rounded,
-// locale-free, bounded by `end` (no null-termination scan), and ~4x faster
-// than strtod. strtod's extras (hex floats, leading '+') don't occur in this
-// format except '+' signs, which we skip ourselves for parity with the
-// Python parser's float().
-inline const char* parse_value(const char* q, const char* end, double* out) {
-  if (q < end && *q == '+') ++q;
-  auto r = std::from_chars(q, end, *out);
-  if (r.ec == std::errc()) return r.ptr;
-  if (r.ec == std::errc::result_out_of_range) {
-    // '1e400' / '1e-400': keep strtod's ±HUGE_VAL / ±0 semantics (what
-    // Python's float() does too) rather than rejecting the file; the token
-    // ends before `end` and the file buffer is NUL-terminated, so strtod
-    // cannot scan out of bounds. Rare, so the slow path costs nothing.
-    char* next = nullptr;
-    *out = std::strtod(q, &next);
-    if (next == q || next > end) return nullptr;
-    return next;
-  }
-  return nullptr;
+inline char* format_value(char* p, char* cap, float v) {
+#if defined(__cpp_lib_to_chars)
+  return std::to_chars(p, cap, v).ptr;
+#else
+  return p + std::snprintf(p, cap - p, "%.9g", static_cast<double>(v));
+#endif
 }
-
-struct FileBuf {
-  char* data = nullptr;
-  size_t size = 0;
-  ~FileBuf() { std::free(data); }
-  int read(const char* path) {
-    FILE* f = std::fopen(path, "rb");
-    if (!f) return -errno;
-    std::fseek(f, 0, SEEK_END);
-    long n = std::ftell(f);
-    std::fseek(f, 0, SEEK_SET);
-    data = static_cast<char*>(std::malloc(n + 1));
-    if (!data) {
-      std::fclose(f);
-      return -ENOMEM;
-    }
-    size = std::fread(data, 1, n, f);
-    data[size] = '\0';
-    std::fclose(f);
-    return 0;
-  }
-};
 
 }  // namespace
 
@@ -221,7 +196,7 @@ int save_coo_impl(const char* path, const int64_t* rows, const int64_t* cols,
     *p++ = ' ';
     p = std::to_chars(p, cap, static_cast<long long>(cols[k])).ptr;
     *p++ = ' ';
-    p = std::to_chars(p, cap, vals[k]).ptr;
+    p = format_value(p, cap, vals[k]);
     *p++ = '\n';
     used = p - buf;
   }

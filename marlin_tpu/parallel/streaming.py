@@ -83,6 +83,13 @@ def iter_row_chunks(a, chunk_rows: int) -> Iterator[np.ndarray]:
 
 
 def _as_chunks(a_source, chunk_rows: int) -> Iterable[np.ndarray]:
+    if hasattr(a_source, "iter_chunks"):
+        # a ChunkStore (io/chunkstore.py): native mmap'd reads at the
+        # STREAMING chunk size — scatter/gather decouples it from the
+        # on-disk chunk size. Checked before the array duck-type: a store
+        # also has .shape, but slicing it per-chunk would lose the native
+        # window gather.
+        return a_source.iter_chunks(chunk_rows)
     if hasattr(a_source, "shape") and hasattr(a_source, "__getitem__"):
         return iter_row_chunks(a_source, chunk_rows)
     return a_source  # already an iterable of chunks

@@ -23,6 +23,11 @@ point               fires from
                     :class:`~marlin_tpu.parallel.prefetch.ChunkPrefetcher`
                     before each source-chunk read (ctx carries
                     ``path="chunk-<i>"`` so ``match`` can target one chunk)
+``dataplane.read``  :meth:`~marlin_tpu.io.chunkstore.ChunkStore.read_rows`
+                    before each native window read (ctx carries
+                    ``path="<store name>@<row>"`` and ``index=<row>`` so
+                    ``match`` can target one window) — torn chunk / bad
+                    checksum / short mmap chaos for the data plane
 ``serve.enqueue``   :meth:`~marlin_tpu.serving.engine.ServeEngine.submit`
                     entry (ctx carries ``path=<rid>``) — a raise here
                     surfaces to the submitting caller
@@ -82,8 +87,8 @@ __all__ = [
 
 KNOWN_POINTS = frozenset({
     "ckpt.write", "ckpt.manifest", "fs.open", "fs.list", "step.run",
-    "device.probe", "prefetch.produce", "serve.enqueue", "serve.step",
-    "serve.prefill", "serve.decode_step", "serve.worker_crash",
+    "device.probe", "prefetch.produce", "dataplane.read", "serve.enqueue",
+    "serve.step", "serve.prefill", "serve.decode_step", "serve.worker_crash",
     "serve.router_route",
 })
 

@@ -1,0 +1,379 @@
+"""MarlinChunk binary container — the native out-of-core data plane.
+
+Covers the acceptance contract of the data-plane subsystem: round-trip
+property grid (dtype x chunk_rows x shape), text->chunks->array bit-exactness
+against the text loaders at the same dtype, corruption detection (a single
+flipped byte is always a checksum error, never silently wrong data),
+truncation detection at open, the ``dataplane.read`` chaos point surfacing
+through the prefetcher's exception-at-position contract, compile-count
+discipline, loader auto-selection (fresh sidecar wins, stale sidecar is
+skipped), and the CLI.
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from marlin_tpu import native
+from marlin_tpu.config import config_context
+from marlin_tpu.io.chunkstore import (
+    ChunkStore,
+    ChunkStoreWriter,
+    ChunkstoreCorruptError,
+    ChunkstoreError,
+    open_sidecar,
+    sidecar_path,
+    transcode_idx,
+    transcode_text,
+    write_chunkstore,
+    _main as chunkstore_cli,
+)
+from marlin_tpu.utils import faults
+
+
+@pytest.fixture(scope="module")
+def lib_ok():
+    if not native.chunkstore_available():
+        pytest.skip(f"native chunkstore library not built "
+                    f"({native.build_error()})")
+    return True
+
+
+def _bf16():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _write_text(path, a):
+    with open(path, "w") as f:
+        for i in range(a.shape[0]):
+            f.write(f"{i}:" + ",".join(repr(float(v)) for v in a[i]) + "\n")
+
+
+# ----------------------------------------------------------- round-trip grid
+@pytest.mark.parametrize("dtype", ["float32", "float64", "bfloat16"])
+@pytest.mark.parametrize("chunk_rows", [1, 7, 64])
+@pytest.mark.parametrize("shape", [(1, 1), (64, 8), (101, 5)])
+def test_roundtrip_grid(tmp_path, lib_ok, dtype, chunk_rows, shape):
+    rng = np.random.default_rng(hash((dtype, chunk_rows, shape)) % 2**32)
+    a = rng.standard_normal(shape)
+    p = str(tmp_path / "g.mchunk")
+    write_chunkstore(p, a, chunk_rows=chunk_rows, dtype=dtype)
+    # the expected stored values: numpy's own cast chain (bf16 goes through
+    # f32, the same double-rounding path the C converter takes)
+    if dtype == "bfloat16":
+        expect = a.astype(np.float32).astype(_bf16())
+    else:
+        expect = a.astype(dtype)
+    with ChunkStore(p) as s:
+        assert s.shape == shape
+        assert s.chunk_rows == chunk_rows
+        assert s.nchunks == -(-shape[0] // chunk_rows)
+        got = s.read_rows(0, shape[0], dtype=dtype)
+        assert got.dtype == expect.dtype
+        assert np.array_equal(
+            got.view(np.uint16) if dtype == "bfloat16" else got,
+            expect.view(np.uint16) if dtype == "bfloat16" else expect)
+        # re-chunked iteration at a DIFFERENT granularity sees the same rows
+        got2 = np.concatenate(list(s.iter_chunks(chunk_rows + 3, dtype=dtype)))
+        assert np.array_equal(got2.astype(np.float64),
+                              expect.astype(np.float64))
+
+
+def test_window_gather_and_cross_dtype(tmp_path, lib_ok):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((200, 6))
+    p = str(tmp_path / "w.mchunk")
+    write_chunkstore(p, a, chunk_rows=16, dtype="float64")
+    with ChunkStore(p) as s:
+        for start, n in [(0, 200), (5, 1), (15, 2), (16, 16), (150, 50),
+                         (199, 1), (0, 0)]:
+            assert np.array_equal(s.read_rows(start, n), a[start:start + n])
+        # native f64 -> f32 conversion matches numpy's cast bit-for-bit
+        assert np.array_equal(s.read_rows(3, 40, dtype="float32"),
+                              a[3:43].astype(np.float32))
+        # caller-provided buffer is filled in place, no allocation
+        out = np.empty((20, 6), np.float64)
+        got = s.read_rows(10, 20, out=out)
+        assert got is out and np.array_equal(out, a[10:30])
+        with pytest.raises(IndexError):
+            s.read_rows(190, 20)
+        with pytest.raises(ValueError):
+            s.read_rows(0, 5, out=np.empty((5, 6), np.float32))
+
+
+def test_writer_incremental_appends(tmp_path, lib_ok):
+    """Chunk size on disk is a property of the file, not of the append
+    granularity — single rows in, chunk_rows-sized chunks out."""
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((37, 4)).astype(np.float32)
+    p = str(tmp_path / "inc.mchunk")
+    with ChunkStoreWriter(p, 4, chunk_rows=8, dtype="float32") as w:
+        for row in a:
+            w.append(row)
+    with ChunkStore(p) as s:
+        assert s.nchunks == 5 and s.chunk_rows == 8
+        assert np.array_equal(s.read_rows(0, 37, dtype="float32"), a)
+
+
+def test_writer_abort_unlinks_partial(tmp_path, lib_ok):
+    p = str(tmp_path / "part.mchunk")
+    with pytest.raises(RuntimeError, match="boom"):
+        with ChunkStoreWriter(p, 3, chunk_rows=4) as w:
+            w.append(np.ones((2, 3)))
+            raise RuntimeError("boom")
+    assert not os.path.exists(p)
+
+
+# ------------------------------------------------------- text-path parity
+@pytest.mark.filterwarnings("ignore:overflow encountered in cast")
+def test_text_transcode_bit_exact_vs_text_loaders(tmp_path, lib_ok):
+    from marlin_tpu.io.text import (iter_matrix_file_chunks,
+                                    load_matrix_file_out_of_core)
+
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((150, 12))
+    a[0, 0] = 1e-300  # exercise the full f64 exponent range through repr()
+    a[0, 1] = -1e300
+    txt = str(tmp_path / "m.txt")
+    _write_text(txt, a)
+    transcode_text(txt, chunk_rows=32)
+    with ChunkStore(sidecar_path(txt)) as s:
+        assert s.dtype == np.float64  # exact parse, the bit-exactness dtype
+        stored = np.concatenate(list(s.iter_chunks(32)))
+    parsed = np.concatenate(list(iter_matrix_file_chunks(txt, 32)))
+    assert np.array_equal(stored, parsed)  # bit-exact vs the Python parser
+    assert np.array_equal(stored, a)       # ... which round-trips repr()
+
+    # end to end: same chunk geometry -> same accumulation order -> the
+    # streamed results are bit-identical on both data planes
+    ooc_text = load_matrix_file_out_of_core(txt, chunk_rows=32,
+                                            chunkstore=False)
+    ooc_store = load_matrix_file_out_of_core(txt, chunk_rows=32)
+    assert "chunkstore" in repr(ooc_store)
+    assert "chunkstore" not in repr(ooc_text)
+    # equal_nan: the planted 1e300 overflows the f32 accumulator to the
+    # SAME inf/nan pattern on both legs — still a bit-identical story
+    assert np.array_equal(ooc_text.gramian(), ooc_store.gramian(),
+                          equal_nan=True)
+    b = rng.standard_normal((12, 5)).astype(np.float32)
+    assert np.array_equal(ooc_text.multiply(b), ooc_store.multiply(b),
+                          equal_nan=True)
+    assert ooc_text.sum() == ooc_store.sum()
+    # random access hits the store, not a scan
+    assert np.array_equal(ooc_store.slice_rows(33, 70), a[33:70])
+
+
+def test_transcode_rejects_untranscodable_text(tmp_path, lib_ok):
+    txt = str(tmp_path / "gapped.txt")
+    with open(txt, "w") as f:
+        f.write("0:1.0,2.0\n5:3.0,4.0\n")  # gapped rows: buffering-loader-only
+    with pytest.raises(ValueError, match="contiguous"):
+        transcode_text(txt)
+    assert not os.path.exists(sidecar_path(txt))  # no torn sidecar left
+
+
+# -------------------------------------------------------------- corruption
+def test_corrupt_chunk_always_detected(tmp_path, lib_ok):
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((64, 8))
+    p = str(tmp_path / "c.mchunk")
+    write_chunkstore(p, a, chunk_rows=16, dtype="float64")
+    blob = bytearray(open(p, "rb").read())
+    # flip one byte in the SECOND chunk's body (64B file header + chunk 0
+    # header+body + chunk 1 header, then 5 bytes in)
+    stride = 32 + 16 * 8 * 8
+    blob[64 + stride + 32 + 5] ^= 0x01
+    open(p, "wb").write(bytes(blob))
+    from marlin_tpu.io.chunkstore import _metric_families
+
+    bad_before = _metric_families()[2].value
+    with ChunkStore(p) as s:
+        # a full read, a windowed read touching the bad chunk, and even a
+        # PARTIAL window of it (CRC covers the whole chunk) must all raise
+        for start, n in [(0, 64), (16, 16), (20, 2)]:
+            with pytest.raises(ChunkstoreCorruptError, match="checksum"):
+                s.read_rows(start, n)
+        # windows that never touch the damaged chunk still read fine
+        assert np.array_equal(s.read_rows(0, 16), a[:16])
+        assert np.array_equal(s.read_rows(32, 32), a[32:])
+        # verify=False documents the trust-the-file escape hatch
+        assert s.read_rows(16, 16, verify=False).shape == (16, 8)
+    assert _metric_families()[2].value >= bad_before + 3
+
+
+def test_truncated_store_detected_at_open(tmp_path, lib_ok):
+    rng = np.random.default_rng(4)
+    p = str(tmp_path / "t.mchunk")
+    write_chunkstore(p, rng.standard_normal((64, 8)), chunk_rows=16)
+    blob = open(p, "rb").read()
+    for cut in (len(blob) - 7, 64 + 10, 40, 3):
+        open(p, "wb").write(blob[:cut])
+        with pytest.raises(ChunkstoreCorruptError):
+            ChunkStore(p)
+    # trailing garbage is a layout violation too
+    open(p, "wb").write(blob + b"xx")
+    with pytest.raises(ChunkstoreError):
+        ChunkStore(p)
+    open(p, "wb").write(b"NOTACHUNKSTORE!!" * 8)
+    with pytest.raises(ChunkstoreError):
+        ChunkStore(p)
+
+
+# ------------------------------------------------------------------- chaos
+def test_chaos_fault_surfaces_at_stream_position(tmp_path, lib_ok):
+    """A ``dataplane.read`` fault in window k surfaces from the prefetcher
+    exactly after the k preceding windows were delivered intact — the
+    exception-at-position contract, on the chunkstore source."""
+    from marlin_tpu.parallel.prefetch import ChunkPrefetcher
+
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((80, 4))
+    p = str(tmp_path / "chaos.mchunk")
+    write_chunkstore(p, a, chunk_rows=8, dtype="float64")
+    with ChunkStore(p) as s:
+        with faults.injected("dataplane.read",
+                             faults.RaiseFault(match="@24")):  # 4th window
+            got = []
+            with ChunkPrefetcher(s.iter_chunks(8), device_put=False) as pf:
+                with pytest.raises(faults.FaultInjected):
+                    for c in pf:
+                        got.append(np.asarray(c))
+        assert len(got) == 3
+        assert np.array_equal(np.concatenate(got), a[:24])
+        # the store survives the fault: the same window reads fine after
+        assert np.array_equal(s.read_rows(24, 8), a[24:32])
+
+
+def test_chaos_corruption_surfaces_through_streamed_op(tmp_path, lib_ok):
+    """Real (not injected) corruption propagates out of a streamed op run
+    on the prefetch pipeline, not just out of a bare read."""
+    from marlin_tpu.matrix.out_of_core import OutOfCoreMatrix
+
+    rng = np.random.default_rng(6)
+    a = rng.standard_normal((64, 8))
+    p = str(tmp_path / "cc.mchunk")
+    write_chunkstore(p, a, chunk_rows=16, dtype="float64")
+    blob = bytearray(open(p, "rb").read())
+    blob[64 + 32 + 9] ^= 0xFF
+    open(p, "wb").write(bytes(blob))
+    with ChunkStore(p) as s:
+        with pytest.raises(ChunkstoreCorruptError):
+            OutOfCoreMatrix(s, chunk_rows=16).gramian(prefetch=True)
+
+
+# --------------------------------------------------------- compile discipline
+def test_streamed_ops_compile_counts_unchanged(tmp_path, lib_ok,
+                                               compile_count):
+    """Swapping the data plane must not change the compiled-program story:
+    chunkstore-fed streamed ops reuse the module-level jits already warmed
+    by array-fed ones (same chunk geometry -> zero new compiles)."""
+    from marlin_tpu.parallel.streaming import streamed_gramian
+
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((64, 8)).astype(np.float32)
+    p = str(tmp_path / "jit.mchunk")
+    write_chunkstore(p, a, chunk_rows=16, dtype="float32")
+    g_ref = streamed_gramian(a, chunk_rows=16)  # warm the chunk programs
+    with ChunkStore(p) as s:
+        with compile_count() as c:
+            g = streamed_gramian(s, chunk_rows=16)
+        assert c.count == 0
+        assert np.array_equal(g, g_ref)
+
+
+# ----------------------------------------------------------- config knobs
+def test_direct_bf16_staging(tmp_path, lib_ok):
+    """data_plane_dtype=bfloat16: chunks surface already-compressed, so the
+    streamed ops' host-side transfer cast sees a no-op."""
+    from marlin_tpu.parallel.streaming import _compress_for_transfer
+
+    rng = np.random.default_rng(8)
+    a = rng.standard_normal((32, 6)).astype(np.float32)
+    p = str(tmp_path / "bf.mchunk")
+    write_chunkstore(p, a, chunk_rows=8, dtype="float32")
+    with ChunkStore(p) as s:
+        with config_context(data_plane_dtype="bfloat16"):
+            chunk = next(s.iter_chunks(8))
+            assert chunk.dtype == _bf16()
+            assert _compress_for_transfer(chunk, "bfloat16") is chunk
+            assert np.array_equal(chunk, a[:8].astype(_bf16()))
+        with config_context(data_plane_threads=1, data_plane_verify=False):
+            assert np.array_equal(s.read_rows(0, 32, dtype="float32"), a)
+
+
+def test_dataplane_metrics_flow(tmp_path, lib_ok):
+    from marlin_tpu.io.chunkstore import _metric_families
+
+    chunks_m, bytes_m, _ = _metric_families()
+    rng = np.random.default_rng(9)
+    a = rng.standard_normal((40, 4))
+    p = str(tmp_path / "m.mchunk")
+    write_chunkstore(p, a, chunk_rows=10, dtype="float64")
+    c0, b0 = chunks_m.value, bytes_m.value
+    with ChunkStore(p) as s:
+        s.read_rows(0, 40)
+    assert chunks_m.value == c0 + 4         # 4 disk chunks touched
+    assert bytes_m.value == b0 + 40 * 4 * 8  # delivered buffer bytes
+
+
+# ------------------------------------------------------------ auto-selection
+def test_stale_sidecar_is_skipped(tmp_path, lib_ok):
+    from marlin_tpu.io.text import load_matrix_file_out_of_core
+
+    rng = np.random.default_rng(10)
+    a = rng.standard_normal((20, 3))
+    txt = str(tmp_path / "m.txt")
+    _write_text(txt, a)
+    transcode_text(txt)
+    assert open_sidecar(txt) is not None
+    # edit the source afterwards: the sidecar is now stale and must be
+    # ignored (a silently shadowing stale sidecar would be a wrong answer)
+    future = os.path.getmtime(sidecar_path(txt)) + 10
+    os.utime(txt, (future, future))
+    assert open_sidecar(txt) is None
+    assert "chunkstore" not in repr(load_matrix_file_out_of_core(txt))
+    # chunkstore=True rebuilds it on the spot
+    ooc = load_matrix_file_out_of_core(txt, chunkstore=True)
+    assert "chunkstore" in repr(ooc)
+    assert np.array_equal(ooc.slice_rows(0, 20), a)
+
+
+def test_mnist_idx_chunkstore_path(tmp_path, lib_ok):
+    from marlin_tpu.io.mnist import mnist_images_out_of_core
+
+    rng = np.random.default_rng(11)
+    raw = rng.integers(0, 256, (50, 4, 3), dtype=np.uint8)
+    idx = str(tmp_path / "images-idx3-ubyte")
+    with open(idx, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 50, 4, 3))
+        f.write(raw.tobytes())
+    ref = mnist_images_out_of_core(idx, chunk_rows=16, chunkstore=False)
+    transcode_idx(idx, chunk_rows=16)
+    ooc = mnist_images_out_of_core(idx, chunk_rows=16)
+    assert "chunkstore" in repr(ooc)
+    assert ooc.shape == ref.shape == (50, 12)
+    # stored f32 is exactly the normalized value the idx path yields
+    assert np.array_equal(ooc.slice_rows(0, 50), ref.slice_rows(0, 50))
+    assert np.array_equal(ooc.gramian(), ref.gramian())
+
+
+# -------------------------------------------------------------------- CLI
+def test_cli_build_info_verify(tmp_path, lib_ok, capsys):
+    rng = np.random.default_rng(12)
+    a = rng.standard_normal((30, 5))
+    txt = str(tmp_path / "m.txt")
+    _write_text(txt, a)
+    assert chunkstore_cli(["build", txt, "--chunk-rows", "8"]) == 0
+    assert chunkstore_cli(["info", sidecar_path(txt)]) == 0
+    assert chunkstore_cli(["verify", sidecar_path(txt)]) == 0
+    out = capsys.readouterr().out
+    assert "30x5" in out and "OK" in out
+    blob = bytearray(open(sidecar_path(txt), "rb").read())
+    blob[-1] ^= 0xFF
+    open(sidecar_path(txt), "wb").write(bytes(blob))
+    with pytest.raises(ChunkstoreCorruptError):
+        chunkstore_cli(["verify", sidecar_path(txt)])

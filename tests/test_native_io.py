@@ -128,6 +128,48 @@ def test_coordinate_matrix_save_uses_native(tmp_path, mesh, lib_ok):
     np.testing.assert_allclose(np.asarray(back.values), [1.5, -2.25, 3.0])
 
 
+def test_native_read_error_surfaces(tmp_path, lib_ok):
+    # FileBuf::read checks fread against the stat'd size: an unreadable
+    # "file" (a directory — fopen succeeds on Linux, fread fails EISDIR)
+    # must raise an OSError, not return a garbage/empty matrix. Regression
+    # for the unchecked-fread bug where short reads parsed as truncated data.
+    with pytest.raises(OSError):
+        native.load_matrix_text(str(tmp_path))
+
+
+def test_build_failure_warns_once_and_is_queryable(monkeypatch, tmp_path):
+    """A failed native build must be loud (one RuntimeWarning carrying the
+    captured stderr) and queryable (build_error()), never a silent fallback
+    to the 100x-slower Python plane."""
+    import warnings
+
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_chunk_lib", None)
+    monkeypatch.setattr(native, "_tried_build", False)
+    monkeypatch.setattr(native, "_build_error", None)
+    monkeypatch.setattr(native, "_warned", False)
+    monkeypatch.setattr(native, "_SO", str(tmp_path / "absent.so"))
+    monkeypatch.setattr(native, "_CHUNK_SO", str(tmp_path / "absent2.so"))
+
+    class _Proc:
+        returncode = 2
+        stdout = ""
+        stderr = "g++: fatal error: no such toolchain"
+
+    monkeypatch.setattr(native.subprocess, "run",
+                        lambda *a, **k: _Proc())
+    with pytest.warns(RuntimeWarning, match="no such toolchain"):
+        assert native._load() is None
+    assert not native.available()
+    assert "make exited 2" in native.build_error()
+    assert "no such toolchain" in native.build_error()
+    # the warning fires ONCE; later probes (either library) stay quiet
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert native._load_chunkstore() is None
+        assert not native.chunkstore_available()
+
+
 def test_native_out_of_range_tokens(tmp_path, lib_ok):
     # float('1e400') -> inf in Python; the native parser must agree, not
     # reject the file (from_chars result_out_of_range fallback)

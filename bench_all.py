@@ -418,8 +418,21 @@ def config_bsr(grid=256, bs=128, p=256, block_density=0.05):
                     jnp.asarray(ids % grid, jnp.int32), (n, n), bs)
     b = jnp.asarray(rng.standard_normal((n, p)).astype(np.float32))
     flops = 2.0 * nnzb * bs * bs * p
-    for name, fn in (("chunked", lambda: bsr_spmm(bsr, b)),
-                     ("pallas", lambda: bsr_spmm_pallas(bsr, b))):
+    # the Pallas leg runs the Mosaic kernel on TPU; in interpret mode (CPU)
+    # it is minutes per call at this scale — a debugging path, not a
+    # measurement — so it defaults off unless a real TPU backend is up
+    import jax as _jax
+
+    run_pallas = os.environ.get(
+        "MARLIN_BENCH_BSR_PALLAS",
+        "1" if _jax.default_backend() == "tpu" else "0") != "0"
+    legs = [("chunked", lambda: bsr_spmm(bsr, b))]
+    if run_pallas:
+        legs.append(("pallas", lambda: bsr_spmm_pallas(bsr, b)))
+    else:
+        log("bsr pallas leg skipped (interpret mode; "
+            "MARLIN_BENCH_BSR_PALLAS=1 forces)")
+    for name, fn in legs:
         out = fn()
         float(jnp.sum(out))
         t0 = time.perf_counter()
@@ -429,6 +442,26 @@ def config_bsr(grid=256, bs=128, p=256, block_density=0.05):
         dt = (time.perf_counter() - t0) / 5
         record(f"bsr_{n}x{n}_bd{block_density}_{name}", flops / dt / 1e9,
                "GFLOP/s", f"{dt * 1e3:.1f} ms, nnzb={nnzb}, bs={bs}, p={p}")
+
+    # the generated-family record: the autotune ranking over chunked-chunk
+    # variants + the Pallas kernel picks the dispatch winner (what
+    # backend="auto" will run); the record shows the winner's rate and the
+    # full measured ordering. Off-TPU the interpret-mode kernel is excluded
+    # for the same reason as above (explicit candidate lists don't pin the
+    # dispatch cache — the record is a measurement, not a winner override).
+    from marlin_tpu.ops import tile_family
+    from marlin_tpu.parallel import autotune
+
+    cands = None
+    if not run_pallas:
+        cands = [c for c in tile_family.bsr_candidates(
+            bs, bsr.nnzb, p, 4) if c != "pallas"]
+    ranking = autotune.tune_bsr(bsr, b, candidates=cands, reps=2)
+    win, sec = ranking[0]
+    order = ", ".join(f"{nm} {s * 1e3:.1f}ms" for nm, s in ranking)
+    record(f"bsr_{n}x{n}_bd{block_density}_family", flops / sec / 1e9,
+           "GFLOP/s", f"winner {win} of [{order}]; nnzb={nnzb}, bs={bs}, "
+           f"p={p} (backend='auto' dispatches this)")
 
 
 def config_nn(m=262_144, d=784, hidden=1024, classes=10, batch=8192,
@@ -741,6 +774,10 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     max_batch = int(os.environ.get("MARLIN_BENCH_SERVE_BATCH", 8))
     warmup = os.environ.get("MARLIN_BENCH_SERVE_WARMUP", "1") != "0"
     paged = os.environ.get("MARLIN_BENCH_SERVE_PAGED", "1") != "0"
+    # decode-kernel A/B control: "" = the config default ('auto'),
+    # "gather"/"pallas" force a backend and tag every record key with _k…
+    # so both legs coexist in BENCH_ALL.json
+    decode_kernel = os.environ.get("MARLIN_BENCH_DECODE_KERNEL", "")
     prefix_len = int(os.environ.get("MARLIN_BENCH_SERVE_PREFIX_LEN", "0"))
     if prefix_len > 240:
         # prompts must leave the per-request tail (8..) room inside the
@@ -752,7 +789,8 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     router_n = int(os.environ.get("MARLIN_BENCH_SERVE_ROUTER", "0"))
     suffix = (("_prefix" if prefix_len else "")
               + ("" if paged else "_slab")
-              + ("_router" if router_n else ""))
+              + ("_router" if router_n else "")
+              + (f"_k{decode_kernel}" if decode_kernel else ""))
     steps_lo, steps_hi = (int(v) for v in os.environ.get(
         "MARLIN_BENCH_SERVE_STEPS", "4,32").split(","))
     buckets = ((64, 32), (256, 32))
@@ -779,7 +817,8 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     def make_engine():
         return ServeEngine(params, heads, buckets=buckets,
                            max_batch=max_batch, max_wait_ms=5.0,
-                           queue_depth=4 * n_req, paged=paged)
+                           queue_depth=4 * n_req, paged=paged,
+                           decode_kernel=decode_kernel or None)
 
     def run_rate(rate):
         nonlocal scrape

@@ -152,6 +152,14 @@ class MarlinConfig:
     # (refcounted, LRU-evicted under pressure) keyed by a rolling hash of
     # their tokens, so a shared system prompt is prefilled once and reused.
     serve_prefix_cache: bool = True
+    # Paged decode-attention backend: 'pallas' runs the fused
+    # ops/paged_attention kernel (reads the page slab in place through the
+    # block table — no gather-materialized context; page_len must be a
+    # multiple of 8, the engine aligns it), 'gather' the reference
+    # gather-then-attend path, 'auto' picks pallas on real TPU and gather
+    # elsewhere (interpret-mode Pallas is for tests, not serving). Greedy
+    # token streams are identical across backends.
+    serve_decode_kernel: str = "auto"
     # --- serving resilience (serving/supervisor.py, serving/router.py) ------
     # Supervisor watchdog: a worker whose heartbeat is older than this many
     # real seconds while work is pending is declared stuck and recovered

@@ -244,7 +244,11 @@ class SparseVecMatrix:
         elif format == "bcoo":
             out = mult_sparse_dense(self.bcoo, dense)
         elif format == "bsr":
-            out = self.to_bsr().multiply(dense)
+            # the BSR backend is the autotune ranking's pick over the
+            # generated family (chunked-XLA chunk sizes + the Pallas
+            # kernel), timed once per configuration — never a hand-coded
+            # preference for the kernel
+            out = self.to_bsr().multiply(dense, backend="auto")
         else:
             raise ValueError(f"unknown SpMM format: {format}")
         return BlockMatrix.from_array(out, self.mesh)

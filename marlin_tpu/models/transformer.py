@@ -1065,11 +1065,31 @@ def _lm_prefill_paged_jit(params, pages, table, chunk, chunk_start, length,
     return new_pages, first
 
 
+def resolve_decode_kernel(kernel: str | None = None) -> str:
+    """Resolve a ``serve_decode_kernel`` setting to a concrete backend.
+
+    ``None`` reads the config knob; ``'auto'`` picks ``'pallas'`` on real
+    TPU (the fused kernel's Mosaic target) and ``'gather'`` elsewhere —
+    interpret-mode Pallas is correct on CPU (the tests run it) but
+    per-page-serialized, far too slow to serve with, while the gather
+    path's scatter fix makes it the fast CPU formulation."""
+    if kernel is None:
+        from ..config import get_config
+
+        kernel = get_config().serve_decode_kernel
+    if kernel == "auto":
+        kernel = "pallas" if jax.default_backend() == "tpu" else "gather"
+    if kernel not in ("pallas", "gather"):
+        raise ValueError(f"serve_decode_kernel must be 'auto', 'pallas' or "
+                         f"'gather', got {kernel!r}")
+    return kernel
+
+
 def lm_decode_paged(params, pages, tables, positions, cur_tokens,
                     steps_done, seeds, temperature, top_p, top_k,
                     heads: int, page_len: int,
                     compute_dtype: str | None = None,
-                    moe: tuple | None = None):
+                    moe: tuple | None = None, kernel: str | None = None):
     """One decode step for every row of a bucket over the paged pool.
 
     ``pages`` is the pool slab (DONATED). ``tables`` is (B, W) int32 block
@@ -1078,12 +1098,23 @@ def lm_decode_paged(params, pages, tables, positions, cur_tokens,
     whose outputs the scheduler ignores, exactly the dense-slab dummy-row
     contract. ``cur_tokens`` is each row's last emitted token (the engine
     keeps the token stream host-side; the result is built from it), the
-    remaining per-row vectors are as :func:`lm_decode_rows`. Each row
-    gathers its context by block table, runs the SAME :func:`_decode_step`
-    math as the slab scheduler (greedy rows stay bit-identical to
-    :func:`lm_generate`), and scatters back the single page it wrote.
-    Returns ``(pages, next_tokens)``. One compile per (B, W) bucket
-    shape."""
+    remaining per-row vectors are as :func:`lm_decode_rows`.
+
+    ``kernel`` selects the attention backend (default: the config's
+    ``serve_decode_kernel``, resolved via :func:`resolve_decode_kernel`):
+
+    - ``'gather'`` — the reference path: each row gathers its context by
+      block table and runs the SAME :func:`_decode_step` math as the slab
+      scheduler (greedy rows stay bit-identical to :func:`lm_generate`),
+      then writes back the single cache entry it produced.
+    - ``'pallas'`` — the fused :func:`~marlin_tpu.ops.paged_attention
+      .paged_decode_attention` kernel attends over the page slab IN PLACE
+      through the block table (no materialized context; requires
+      ``page_len`` a multiple of 8). Greedy token streams match the gather
+      path (logits agree to ~ulp — online softmax reassociates).
+
+    Returns ``(pages, next_tokens)``. One compile per (B, W) bucket shape
+    per backend."""
     as_i32 = lambda v: jnp.asarray(v, jnp.int32)  # noqa: E731
     return _lm_decode_paged_jit(
         params, pages, as_i32(tables), as_i32(positions),
@@ -1091,16 +1122,82 @@ def lm_decode_paged(params, pages, tables, positions, cur_tokens,
         jnp.asarray(seeds, jnp.uint32),
         jnp.asarray(temperature, jnp.float32),
         jnp.asarray(top_p, jnp.float32), as_i32(top_k),
-        heads=heads, page_len=page_len, compute_dtype=compute_dtype, moe=moe)
+        heads=heads, page_len=page_len, compute_dtype=compute_dtype, moe=moe,
+        kernel=resolve_decode_kernel(kernel))
+
+
+def _scatter_kv_entries(pk, pv, k_new, v_new, pids, off):
+    """Write row b's new K/V cache entry to ``(pids[b], off[b])`` of the
+    (donated) page slab as an UNROLLED chain of single-entry dynamic
+    updates. The obvious vector-index form (``pk.at[pids, off].set(...)``)
+    expands on XLA CPU into a while loop whose slab-sized carry COPIES the
+    pool every step — the same pathology (and the same fix) as the prefill
+    scatter above, but here it recurs EVERY decode step and was the whole
+    measured −5±3% no-prefix paged tax. B is small and static, so the
+    unroll is a handful of in-place updates. Dummy rows all target page 0
+    offset 0; their duplicate writes are last-writer garbage in a page
+    nothing valid ever reads (ordering is irrelevant — every write to a
+    location nothing reads is equally garbage)."""
+    B = pids.shape[0]
+    for b in range(B):
+        idx = (pids[b], off[b], 0, 0)
+        pk = jax.lax.dynamic_update_slice(pk, k_new[b][None, None], idx)
+        pv = jax.lax.dynamic_update_slice(pv, v_new[b][None, None], idx)
+    return pk, pv
+
+
+def _decode_paged_pallas(params, pages, tables, pos, x, heads: int,
+                         page_len: int, moe):
+    """The fused-kernel decode body: batched projections, the new K/V entry
+    written to the slab FIRST (so the kernel's length-masked read covers
+    it, exactly as :func:`_decode_step` updates the cache before
+    attending), then one :func:`paged_decode_attention` call per layer
+    over the slab in place. Same layer math as :func:`_decode_step`, batch
+    formulation."""
+    from ..ops.paged_attention import paged_decode_attention
+
+    B, W = tables.shape
+    rows = jnp.arange(B)
+    cd = x.dtype
+    d = x.shape[-1]
+    dh = d // heads
+    pids = tables[rows, pos // page_len]
+    off = pos % page_len
+    lengths = pos + 1  # the just-written entry is live
+    new_pages = {}
+    for i in range(_n_layers(params)):
+        lp = params[f"l{i}"]
+        pk, pv = pages[f"l{i}"]
+        kvh = pk.shape[2]
+        h = _rmsnorm(x, lp["ln1"])
+        q = (h @ lp["wq"].astype(cd)).reshape(B, kvh, heads // kvh, dh)
+        k = (h @ lp["wk"].astype(cd)).reshape(B, kvh, dh)
+        v = (h @ lp["wv"].astype(cd)).reshape(B, kvh, dh)
+        pk, pv = _scatter_kv_entries(pk, pv, k.astype(pk.dtype),
+                                     v.astype(pv.dtype), pids, off)
+        o = paged_decode_attention(q, pk, pv, tables, lengths)
+        x = x + o.reshape(B, d) @ lp["wo"].astype(cd)
+        h = _rmsnorm(x, lp["ln2"])
+        if "moe" in lp:
+            from .moe import moe_decode_ffn
+
+            x = x + jax.vmap(lambda hb, _lp=lp: moe_decode_ffn(
+                _lp["moe"], hb, top_k=(moe or _MOE_DEFAULTS)[0]))(h)
+        else:
+            x = x + jax.nn.gelu(h @ lp["w1"].astype(cd)) @ lp["w2"].astype(cd)
+        new_pages[f"l{i}"] = (pk, pv)
+    x = _rmsnorm(x, params["ln_f"])
+    return _head_logits(x, params["emb"]), new_pages
 
 
 @functools.partial(jax.jit, static_argnames=("heads", "page_len",
-                                             "compute_dtype", "moe"),
+                                             "compute_dtype", "moe",
+                                             "kernel"),
                    donate_argnums=(1,))
 def _lm_decode_paged_jit(params, pages, tables, positions, cur_tokens,
                          steps_done, seeds, temperature, top_p, top_k,
                          heads: int, page_len: int, compute_dtype,
-                         moe=None):
+                         moe=None, kernel: str = "gather"):
     B, W = tables.shape
     L = W * page_len
     rows = jnp.arange(B)
@@ -1110,6 +1207,13 @@ def _lm_decode_paged_jit(params, pages, tables, positions, cur_tokens,
     # of clipping out of bounds
     pos = jnp.minimum(positions, L - 1)
     x = params["emb"][cur_tokens].astype(cdtype)
+    if kernel == "pallas":
+        logits, new_pages = _decode_paged_pallas(
+            params, pages, tables, pos, x, heads, page_len, moe)
+        subs = jax.vmap(_row_key)(seeds, steps_done)
+        nxt = jax.vmap(_pick_token_row)(temperature, top_p, top_k, logits,
+                                        subs)
+        return new_pages, nxt
     # gather each row's context in block-table order: position t of the
     # gathered view IS absolute position t, so _decode_step's positional
     # masking applies unchanged — the decode math is literally the slab
@@ -1121,12 +1225,10 @@ def _lm_decode_paged_jit(params, pages, tables, positions, cur_tokens,
     )(x, ctx, pos)
     subs = jax.vmap(_row_key)(seeds, steps_done)
     nxt = jax.vmap(_pick_token_row)(temperature, top_p, top_k, logits, subs)
-    # scatter back the ONE cache entry each row wrote — sliced at pos out
+    # write back the ONE cache entry each row produced — sliced at pos out
     # of the updated per-row context, which lets XLA fold the update-then-
     # slice into the entry itself instead of materializing a whole updated
-    # context copy per layer. Dummy rows all target page 0 offset 0; their
-    # duplicate scatter is last-writer garbage in a page nothing valid
-    # ever reads.
+    # context copy per layer
     pids = tables[rows, pos // page_len]
     off = pos % page_len
     new_pages = {}
@@ -1136,9 +1238,9 @@ def _lm_decode_paged_jit(params, pages, tables, positions, cur_tokens,
         def entry(c, p):
             return jax.lax.dynamic_index_in_dim(c, p, 0, keepdims=False)
 
-        new_pages[name] = (
-            pk.at[pids, off].set(jax.vmap(entry)(ck, pos).astype(pk.dtype)),
-            pv.at[pids, off].set(jax.vmap(entry)(cv, pos).astype(pv.dtype)))
+        new_pages[name] = _scatter_kv_entries(
+            pk, pv, jax.vmap(entry)(ck, pos).astype(pk.dtype),
+            jax.vmap(entry)(cv, pos).astype(pv.dtype), pids, off)
     return new_pages, nxt
 
 

@@ -9,5 +9,8 @@ from .local import (  # noqa: F401
     syrk,
     triu_to_full,
 )
+from .paged_attention import align_page_len, paged_decode_attention  # noqa: F401
 from .sparse_bsr import BsrMatrix, bsr_from_dense, bsr_spmm  # noqa: F401
+from .sparse_bsr import bsr_spmm_pallas  # noqa: F401
 from .sparse_ell import EllMatrix, ell_from_coo, ell_spmm  # noqa: F401
+from .tile_family import bsr_candidates, gemm_candidates  # noqa: F401

@@ -1,0 +1,209 @@
+"""Fused paged decode-attention: read the KV page slab in place.
+
+The paged decode path (models/transformer.lm_decode_paged) historically
+GATHERED each row's context out of the page slab by block table
+(``t[tables].reshape(B, L, ...)``) and then ran dense attention over the
+materialized copy — a per-step copy of every live row's whole context whose
+cost the serve bench measured at −5±3% tok/s vs the dense slab on no-prefix
+workloads (BENCH_ALL.json; ROADMAP "fused paged decode-attention kernel").
+This module is the kernel that erases the copy: the block table itself
+drives the Pallas ``index_map``, so page blocks stream HBM→VMEM directly
+from the slab (grid (B, W), pages innermost) and the context is never
+materialized as a separate array.
+
+Shapes follow the slab exactly (:func:`~marlin_tpu.models.transformer
+.init_kv_pages`): K/V pages are ``(num_pages, page_len, kv_heads, dh)``,
+queries arrive in the GQA-grouped form ``(B, kv_heads, group, dh)`` the
+decode step already uses (``group = heads // kv_heads``; plain MHA is the
+group=1 case), and the score/value contractions are the SAME einsums as
+:func:`~marlin_tpu.models.transformer._decode_step` (``kgd,tkd->kgt`` /
+``kgt,tkd->kgd``, f32 scores, masked positions at −1e30) so the kernel's
+math is the reference path's math, re-scheduled. Softmax is the online
+(flash) form: running max ``m``, normalizer ``l`` and the f32 accumulator
+live in VMEM scratch across the page-sequential grid dimension; each page
+block rescales the accumulator by ``exp(m_old − m_new)``. Reduction order
+therefore differs from the dense softmax by float associativity (logits
+agree to ~ulp); greedy argmax is unaffected, which is the serving
+bit-identity contract (tests/test_paged_attention.py drives it).
+
+Per-row ``lengths`` masks the tail: positions ``>= lengths[b]`` score −1e30
+exactly as the gather path masks them, and pages wholly past a row's
+length contribute ``exp(−1e30 − m) = 0`` (the row's first page always has
+at least one live position — lengths are clamped ≥ 1, mirroring the decode
+path's position clamp). Dummy rows (all-zero block tables, the free/
+prefilling-slot contract) attend one masked-harmless position of the
+sacrificial page 0.
+
+``interpret=`` defaults through :func:`~.pallas_kernels._interpret` —
+interpreter everywhere but real TPU — so the tier-1 CPU suites exercise
+the real kernel body, not a stand-in.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_kernels import _interpret
+
+__all__ = ["paged_decode_attention", "align_page_len", "paged_attention_cost",
+           "PAGE_SUBLANE"]
+
+# TPU sublane multiple: the kernel's K/V block second-to-minor dimension is
+# page_len, so pages must stay a multiple of this for an unpadded block
+# (init_kv_pages documents the same constraint for the gather fast path).
+PAGE_SUBLANE = 8
+
+_MASKED = -1e30  # the decode path's mask value — shared so exp() underflows
+#                  to an exact 0.0 for dead positions in both formulations
+
+
+def align_page_len(page_len: int) -> int:
+    """Smallest kernel-legal page length >= ``page_len`` (a multiple of
+    :data:`PAGE_SUBLANE`) — the engine aligns ``serve_page_len`` through
+    here when the pallas decode backend is selected."""
+    if page_len < 1:
+        raise ValueError(f"page_len must be >= 1, got {page_len}")
+    return -(-page_len // PAGE_SUBLANE) * PAGE_SUBLANE
+
+
+def paged_attention_cost(batch: int, table_width: int, page_len: int,
+                         kv_heads: int, group: int, dh: int,
+                         itemsize: int = 4) -> dict:
+    """Analytic cost model for one kernel call, ``cost_analysis()``-shaped
+    (the ProgramCosts capture fallback for the Mosaic path, where the
+    pallas_call is opaque to XLA's analysis; interpret-mode lowerings are
+    analyzed as ordinary XLA ops and don't need this). FLOPs are the two
+    (group·dh × page_len) contractions per (row, page, kv-head); bytes are
+    one in-place pass over each row's table extent of the slab plus q/out."""
+    t = batch * table_width * kv_heads
+    flops = 2.0 * 2.0 * t * group * dh * page_len
+    kv_bytes = 2.0 * t * page_len * dh * itemsize
+    qo_bytes = 2.0 * batch * kv_heads * group * dh * itemsize
+    return {"flops": flops, "bytes accessed": kv_bytes + qo_bytes}
+
+
+def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_ref, m_ref, l_ref, *, page_len: int):
+    """Grid (B, W), W innermost ("arbitrary": pages run sequentially per
+    row). Scalar-prefetched ``tables`` select the K/V block — the in-place
+    read; q/out blocks index by row only, so they stay resident across a
+    row's pages while the online-softmax state accumulates in scratch."""
+    b = pl.program_id(0)
+    w = pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _MASKED)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]  # (kvh, group, dh) — compute dtype
+    k = k_ref[0]  # (page_len, kvh, dh)
+    v = v_ref[0]
+    dh = q.shape[-1]
+    # the _decode_step score einsum, f32 scores, same 1/sqrt(dh) scaling
+    s = jnp.einsum("kgd,tkd->kgt", q, k,
+                   preferred_element_type=jnp.float32) / math.sqrt(dh)
+    # absolute position of column t is w*page_len + t; live iff < length
+    t = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    s = jnp.where(w * page_len + t < lengths_ref[b], s, _MASKED)
+    # online-softmax update: new running max, rescale the old accumulator
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
+    m_ref[:] = m_new
+    alpha = jnp.exp(m_prev - m_new)  # 0.0 on the w==0 init (m_prev=-1e30)
+    p = jnp.exp(s - m_new[:, :, None])  # masked cols underflow to exact 0
+    l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=2)
+    # probabilities meet V in the compute dtype — q's dtype, the same cast
+    # _decode_step applies (p.astype(cd)); the accumulator stays f32
+    pv = jnp.einsum("kgt,tkd->kgd", p.astype(q.dtype), v,
+                    preferred_element_type=jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha[:, :, None] + pv
+
+    @pl.when(w == pl.num_programs(1) - 1)
+    def _flush():
+        o_ref[0] = (acc_ref[:] / l_ref[:][:, :, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_len", "interpret"))
+def _paged_decode_attention_call(q, k_pages, v_pages, tables, lengths,
+                                 page_len: int, interpret: bool):
+    B, kvh, group, dh = q.shape
+    W = tables.shape[1]
+    kernel = functools.partial(_paged_attn_kernel, page_len=page_len)
+    row_spec = pl.BlockSpec((1, kvh, group, dh),
+                            lambda b, w, tbl, lens: (b, 0, 0, 0))
+    # THE in-place read: the block table entry is the K/V block index
+    page_spec = pl.BlockSpec((1, page_len, kvh, dh),
+                             lambda b, w, tbl, lens: (tbl[b, w], 0, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, W),
+            in_specs=[row_spec, page_spec, page_spec],
+            out_specs=row_spec,
+            scratch_shapes=[
+                pltpu.VMEM((kvh, group, dh), jnp.float32),  # accumulator
+                pltpu.VMEM((kvh, group), jnp.float32),      # running max m
+                pltpu.VMEM((kvh, group), jnp.float32),      # normalizer l
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, kvh, group, dh), q.dtype),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(tables, lengths, q, k_pages, v_pages)
+
+
+def paged_decode_attention(q, k_pages, v_pages, tables, lengths,
+                           interpret: bool | None = None) -> jax.Array:
+    """Decode attention for a batch of rows directly over the page slab.
+
+    ``q`` is ``(B, kv_heads, group, dh)`` (the grouped decode-query form;
+    ``group = heads // kv_heads``), ``k_pages``/``v_pages`` the slab
+    ``(num_pages, page_len, kv_heads, dh)``, ``tables`` ``(B, W)`` int32
+    block tables (dummy page 0 beyond a row's extent), ``lengths`` ``(B,)``
+    the number of live positions per row — for a decode step at position
+    ``pos`` whose K/V entry is already written, ``pos + 1``. Returns the
+    attention output ``(B, kv_heads, group, dh)`` in ``q``'s dtype.
+
+    The row's pages are read IN PLACE through the block table (no gathered
+    context array); masking, GQA mapping, and softmax numerics follow
+    :func:`~marlin_tpu.models.transformer._decode_step` (module docstring).
+    """
+    q = jnp.asarray(q)
+    if q.ndim != 4:
+        raise ValueError(f"q must be (B, kv_heads, group, dh), got {q.shape}")
+    if k_pages.shape != v_pages.shape or len(k_pages.shape) != 4:
+        raise ValueError(f"k/v pages must share one (num_pages, page_len, "
+                         f"kv_heads, dh) shape, got {k_pages.shape} vs "
+                         f"{v_pages.shape}")
+    page_len = int(k_pages.shape[1])
+    if k_pages.shape[2] != q.shape[1] or k_pages.shape[3] != q.shape[3]:
+        raise ValueError(f"page slab {k_pages.shape} does not match query "
+                         f"heads {q.shape}")
+    if page_len % PAGE_SUBLANE:
+        raise ValueError(
+            f"page_len {page_len} is not a multiple of {PAGE_SUBLANE} — the "
+            f"kernel's K/V block would be sublane-misaligned; size pages "
+            f"through align_page_len()")
+    tables = jnp.asarray(tables, jnp.int32)
+    if tables.ndim != 2 or tables.shape[0] != q.shape[0]:
+        raise ValueError(f"tables must be (B, W) with B={q.shape[0]}, got "
+                         f"{tables.shape}")
+    W = tables.shape[1]
+    # clamp as the decode path clamps positions: every row attends at least
+    # position 0 (length 1), never past its table extent
+    lengths = jnp.clip(jnp.asarray(lengths, jnp.int32), 1, W * page_len)
+    if interpret is None:
+        interpret = _interpret()
+    return _paged_decode_attention_call(q, k_pages, v_pages, tables, lengths,
+                                        page_len=page_len,
+                                        interpret=bool(interpret))

@@ -88,7 +88,22 @@ class BsrMatrix:
                  backend: str = "chunked") -> jax.Array:
         """``backend="pallas"`` selects the scatter-free VMEM-accumulating
         kernel (:func:`bsr_spmm_pallas`); ``"chunked"`` the batched-einsum +
-        sorted-segment-sum formulation."""
+        sorted-segment-sum formulation; ``"auto"`` consults the autotune
+        ranking over the generated family
+        (:func:`~marlin_tpu.parallel.autotune.best_bsr_strategy` — timed
+        once per configuration, winner persisted per device kind), so a
+        hand-picked kernel can never shadow a faster formulation."""
+        if backend == "auto":
+            if chunk_blocks is not None:
+                raise ValueError(
+                    "chunk_blocks applies only to backend='chunked'")
+            from ..parallel import autotune
+            from .tile_family import parse_bsr_candidate
+
+            cb = parse_bsr_candidate(autotune.best_bsr_strategy(self, b))
+            if cb is None:
+                return bsr_spmm_pallas(self, b)
+            return bsr_spmm(self, b, cb)
         if backend == "pallas":
             if chunk_blocks is not None:
                 raise ValueError(
@@ -299,7 +314,7 @@ def bsr_spmm_pallas(bsr: BsrMatrix, b, interpret: bool | None = None) -> jax.Arr
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((n_block_rows, bs, pp), f32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pltpu.TPUCompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(brows, bcols, copy_of, slot_of.astype(jnp.int32),

@@ -1,0 +1,178 @@
+"""Generated tiling families for the Pallas kernels.
+
+The autotune layer (parallel/autotune.py) used to choose between lone
+hand-written strategies — one fixed (256, 256, 512) ``pallas_matmul``
+tiling, one fixed-chunk BSR formulation. Following "Automatic Generators
+for a Family of Matrix Multiplication Routines" (2310.20347), this module
+turns each of those points into a *family*: enumerate every MXU-aligned
+(bm, bn, bk) block shape, prune the ones that cannot work (VMEM overflow)
+or predictably lose (analytic HBM-traffic model, including the waste of
+padding the problem up to the tile grid — the "Blocking Techniques for
+Sparse Matrix Multiplication on Tensor Accelerators" (2202.05868)
+geometry argument), and hand the survivors to the tuner to time and rank
+on the live device. The generator is pure arithmetic — no jax imports, no
+device access — so candidate enumeration is free and deterministic;
+measurement stays where it belongs, in ``autotune.tune_gemm`` /
+``autotune.tune_bsr``.
+
+Candidate names are strings (``"pallas:256x256x512"``, ``"chunked:128"``,
+``"xla"``) because strings are what the autotune disk cache persists; the
+parse helpers below are the other direction.
+"""
+
+from __future__ import annotations
+
+__all__ = ["TileCandidate", "gemm_candidates", "bsr_candidates",
+           "parse_gemm_candidate", "parse_bsr_candidate", "vmem_bytes",
+           "gemm_traffic_bytes", "MXU_LANE", "SUBLANE", "VMEM_BUDGET_BYTES"]
+
+MXU_LANE = 128  # minor-dim multiple the MXU wants (guide: last dim 128)
+SUBLANE = 8     # second-to-minor multiple for f32
+
+# Working-set ceiling per candidate: both operand tiles double-buffered by
+# the pipeline plus the f32 accumulator must sit in VMEM together. Real
+# cores have ~16 MiB; budgeting 12 MiB leaves room for the pipeline's own
+# staging so a "fits" verdict here never becomes a Mosaic spill.
+VMEM_BUDGET_BYTES = 12 << 20
+
+# The enumeration axes: every MXU-aligned power-of-two block shape between
+# one MXU tile and the VMEM scale. Finer steps exist, but off-power-of-two
+# tiles pad almost every real problem dimension and never won in the
+# 2310.20347 sweeps; the family stays small enough to time exhaustively.
+_BM_AXIS = (128, 256, 512)
+_BN_AXIS = (128, 256, 512)
+_BK_AXIS = (128, 256, 512, 1024, 2048)
+
+
+class TileCandidate(tuple):
+    """(bm, bn, bk) with its autotune spelling. A tuple subclass so the
+    candidate sorts/equates by geometry and still carries the name."""
+
+    __slots__ = ()
+
+    def __new__(cls, bm: int, bn: int, bk: int):
+        return super().__new__(cls, (int(bm), int(bn), int(bk)))
+
+    @property
+    def bm(self) -> int:
+        return self[0]
+
+    @property
+    def bn(self) -> int:
+        return self[1]
+
+    @property
+    def bk(self) -> int:
+        return self[2]
+
+    @property
+    def name(self) -> str:
+        return f"pallas:{self[0]}x{self[1]}x{self[2]}"
+
+    def __repr__(self):
+        return f"TileCandidate({self[0]}, {self[1]}, {self[2]})"
+
+
+def parse_gemm_candidate(name: str) -> TileCandidate:
+    """``"pallas:BMxBNxBK"`` → :class:`TileCandidate` (the autotune cache
+    stores names; the dispatcher needs numbers back)."""
+    if not isinstance(name, str) or not name.startswith("pallas:"):
+        raise ValueError(f"not a pallas gemm candidate: {name!r}")
+    parts = name[len("pallas:"):].split("x")
+    if len(parts) != 3:
+        raise ValueError(f"malformed gemm candidate: {name!r}")
+    return TileCandidate(*(int(p) for p in parts))
+
+
+def parse_bsr_candidate(name: str) -> int | None:
+    """``"chunked:N"`` → N, ``"pallas"`` → None (the BSR kernel has no
+    free tiling — its block shape is the matrix's)."""
+    if name == "pallas":
+        return None
+    if not isinstance(name, str) or not name.startswith("chunked:"):
+        raise ValueError(f"not a bsr candidate: {name!r}")
+    return int(name[len("chunked:"):])
+
+
+def vmem_bytes(bm: int, bn: int, bk: int, itemsize: int = 4) -> int:
+    """Resident VMEM for one grid step: the A (bm, bk) and B (bk, bn)
+    tiles double-buffered (the pipeline prefetches step j+1 while j
+    computes) plus the f32 (bm, bn) accumulator scratch."""
+    return 2 * (bm * bk + bk * bn) * itemsize + bm * bn * 4
+
+
+def _pad_up(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+def _clamp(m: int, n: int, k: int, c: TileCandidate) -> TileCandidate:
+    """The tile pallas_matmul will actually run: it clamps each block dim
+    to the (floored) problem dim, so on small problems distinct candidates
+    collapse to one effective tiling — the family dedupes on this, never
+    timing the same compiled kernel twice under two names."""
+    return TileCandidate(min(c.bm, max(SUBLANE, m)),
+                         min(c.bn, max(MXU_LANE, n)),
+                         min(c.bk, max(MXU_LANE, k)))
+
+
+def gemm_traffic_bytes(m: int, k: int, n: int, bm: int, bn: int, bk: int,
+                       itemsize: int = 4) -> float:
+    """Analytic HBM traffic of the (bm, bn, bk)-blocked m×k×n matmul, the
+    pruning score. The padded problem is (mp, kp, np); each of the
+    (mp/bm)·(np/bn) output tiles streams its full A row-panel and B
+    column-panel, so A moves once per output-column block and B once per
+    output-row block — large bm/bn amortize panel re-reads, but padding a
+    dimension up to an oversized tile is traffic too (the score charges
+    it), which is what keeps 512-wide tiles from "winning" 130-wide
+    problems on arithmetic the measurement would disprove."""
+    mp, np_, kp = _pad_up(m, bm), _pad_up(n, bn), _pad_up(k, bk)
+    a_reads = mp * kp * (np_ // bn) * itemsize
+    b_reads = kp * np_ * (mp // bm) * itemsize
+    out_writes = mp * np_ * itemsize
+    return float(a_reads + b_reads + out_writes)
+
+
+def gemm_candidates(m: int, k: int, n: int, itemsize: int = 4,
+                    max_candidates: int = 6) -> list[TileCandidate]:
+    """The (bm, bn, bk) family for an m×k×n problem: enumerate the aligned
+    axes, clamp to the problem (dedupe collapsed tiles), drop VMEM
+    overflows, rank by :func:`gemm_traffic_bytes`, return the
+    ``max_candidates`` best. Always non-empty — the minimal
+    (128, 128, 128) tile fits any budget this module would be used
+    with."""
+    if min(m, k, n) < 1:
+        raise ValueError(f"degenerate problem: {m}x{k}x{n}")
+    seen: dict[TileCandidate, float] = {}
+    for bm in _BM_AXIS:
+        for bn in _BN_AXIS:
+            for bk in _BK_AXIS:
+                c = _clamp(m, n, k, TileCandidate(bm, bn, bk))
+                if c in seen:
+                    continue
+                if vmem_bytes(c.bm, c.bn, c.bk,
+                              itemsize) > VMEM_BUDGET_BYTES:
+                    continue
+                seen[c] = gemm_traffic_bytes(m, k, n, c.bm, c.bn, c.bk,
+                                             itemsize)
+    ranked = sorted(seen.items(), key=lambda kv: (kv[1], kv[0]))
+    return [c for c, _ in ranked[:max_candidates]]
+
+
+def bsr_candidates(block_size: int, nnzb: int, p: int, itemsize: int = 4,
+                   max_candidates: int = 5) -> list[str]:
+    """The BSR SpMM family: the chunked-XLA formulation at power-of-two
+    ``chunk_blocks`` sizes bracketing its built-in ~32 MB-buffer heuristic
+    (bsr_spmm's default — smaller chunks cut the gather/product buffers,
+    larger ones amortize dispatch), plus the Pallas kernel. Strings,
+    ready for the autotune cache; decode with
+    :func:`parse_bsr_candidate`."""
+    if block_size < 1 or nnzb < 1 or p < 1:
+        raise ValueError(
+            f"degenerate bsr problem: bs={block_size} nnzb={nnzb} p={p}")
+    default = max(1, (1 << 23) // (block_size * max(p, block_size)))
+    sizes = sorted({max(1, min(c, nnzb))
+                    for c in (default // 4, default // 2, default,
+                              default * 2)})
+    out = [f"chunked:{c}" for c in sizes]
+    out.append("pallas")
+    return out[:max_candidates]

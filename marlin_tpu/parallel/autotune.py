@@ -30,7 +30,8 @@ import jax.numpy as jnp
 
 from .matmul import UnknownStrategyError
 
-__all__ = ["tune_multiply", "best_strategy", "clear_cache"]
+__all__ = ["tune_multiply", "best_strategy", "tune_gemm", "best_gemm",
+           "tune_bsr", "best_bsr_strategy", "clear_cache"]
 
 _CACHE: dict[tuple, str] = {}
 
@@ -38,12 +39,21 @@ _CACHE: dict[tuple, str] = {}
 # candidate set costs seconds at production sizes — paying it once per
 # machine, not once per process, is the point). Keyed by the stringified
 # in-memory key, which carries shapes, both operands' layouts/specs, dtypes,
-# precision, mesh shape (device count), and backend platform — a cache entry
-# can never leak across a hardware or layout change. Entries are timings'
-# *winners* only; they are machine-specific by design, hence the local path.
+# precision, mesh shape (device count), backend platform AND device kind —
+# a cache entry can never leak across a hardware or layout change (platform
+# alone says "tpu", which would replay a v4-tuned winner on a v5p). Entries
+# are timings' *winners* only; they are machine-specific by design, hence
+# the local path.
 _DISK_LOCK = threading.Lock()
 _disk: dict[str, str] | None = None  # lazily loaded; path tracked for reloads
 _disk_path_loaded: str | None = None
+
+# Cache-file schema version, stored as an int under "__version__" in the
+# same flat dict as the winners (str-valued keys only otherwise, so loads
+# can filter it out). Bumped when the KEY layout changes — v2 added
+# device_kind — so a file persisted by an older layout is ignored wholesale
+# rather than silently replaying winners under now-ambiguous keys.
+_DISK_VERSION = 2
 
 
 def _disk_path() -> str | None:
@@ -71,7 +81,14 @@ def _disk_layer() -> dict[str, str]:
         try:
             with open(path) as f:
                 data = json.load(f)
-            _disk = {k: v for k, v in data.items() if isinstance(v, str)}
+            if data.get("__version__") != _DISK_VERSION:
+                # a pre-versioned or older-layout file: its keys don't mean
+                # what this version's keys mean — drop it (one re-tune per
+                # configuration, never a wrong winner)
+                _disk = {}
+            else:
+                _disk = {k: v for k, v in data.items()
+                         if isinstance(v, str)}
         except (OSError, ValueError):
             _disk = {}
         _disk_path_loaded = path
@@ -112,7 +129,8 @@ def _persist(key: tuple, strategy: str) -> None:
                 fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
                                            suffix=".tmp")
                 with os.fdopen(fd, "w") as f:
-                    json.dump(layer, f, indent=1, sort_keys=True)
+                    json.dump({"__version__": _DISK_VERSION, **layer}, f,
+                              indent=1, sort_keys=True)
                 os.replace(tmp, path)
             except OSError:
                 pass
@@ -133,9 +151,12 @@ def _operand_meta(other):
 def _cache_key(mat, other, precision):
     """Layouts matter as much as shapes: a row-sharded and a block-sharded
     pair of the same shape reshard differently per strategy, so both operands'
-    specs (and the matrix class) are part of the key."""
+    specs (and the matrix class) are part of the key. Hardware identity is
+    platform AND device_kind — "tpu" alone would replay a winner tuned on
+    one TPU generation on another whose MXU/VMEM balance is different."""
     other_shape, other_dtype, other_spec = _operand_meta(other)
     mesh = mat.mesh
+    dev = mesh.devices.flat[0]
     return (
         type(mat).__name__,
         mat.shape,
@@ -146,7 +167,8 @@ def _cache_key(mat, other, precision):
         str(other_dtype),
         precision,
         tuple(sorted(mesh.shape.items())),
-        mesh.devices.flat[0].platform,
+        dev.platform,
+        getattr(dev, "device_kind", ""),
     )
 
 
@@ -263,6 +285,218 @@ def best_strategy(mat, other, precision: str | None = None) -> str:
             _CACHE[key] = persisted
         else:
             tune_multiply(mat, other, precision=precision)
+    return _CACHE[key]
+
+
+# --------------------------------------------------------------------------
+# Generated-family tuners (ops/tile_family.py): the same two-layer cache and
+# measured-time ranking as tune_multiply, applied to kernel families instead
+# of distributed-multiply engines. tune_multiply picks WHICH engine runs a
+# sharded multiply; these pick WHICH generated tiling (or formulation) runs
+# one local kernel — "Automatic Generators for a Family of Matrix
+# Multiplication Routines" (2310.20347): enumerate + prune analytically
+# (tile_family), then measure and persist the winner per device kind.
+
+
+def _device_sig() -> tuple[str, str]:
+    """(platform, device_kind) of the default device — the hardware half of
+    every local-kernel cache key (local kernels have no mesh to ask)."""
+    import jax
+
+    d = jax.devices()[0]
+    return d.platform, getattr(d, "device_kind", "")
+
+
+def _gemm_key(m: int, k: int, n: int, dtype) -> tuple:
+    return ("gemm", (int(m), int(k), int(n)), str(dtype), *_device_sig())
+
+
+def _time_candidates(program: str, candidates, run, prog_key, analytic,
+                     reps: int):
+    """Shared measurement loop: compile, time ``reps`` back-to-back calls
+    (utils.profiling.evaluate forces true completion), land each candidate
+    in ProgramCosts with the problem's analytic cost — achieved-FLOP/s per
+    candidate is the ranking the report table shows. A candidate that
+    fails to build/run is skipped, not fatal (the family generator can
+    propose a tile the backend rejects)."""
+    from ..obs import perf
+    from ..utils.profiling import evaluate
+
+    costs = perf.get_program_costs()
+    results = []
+    for name in candidates:
+        try:
+            evaluate(run(name))  # compile outside the timed window
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(reps):
+                out = run(name)
+            evaluate(out)
+            elapsed = time.perf_counter() - t0
+        except Exception:
+            continue
+        results.append((name, elapsed / reps))
+        costs.capture(program, prog_key(name), cost=analytic)
+        costs.observe(program, prog_key(name), elapsed, calls=reps)
+    if not results:
+        raise ValueError(f"no {program} candidate could be timed")
+    costs.emit(program)
+    results.sort(key=lambda kv: kv[1])
+    return results
+
+
+def tune_gemm(a, b, candidates=None, reps: int = 3) -> list[tuple[str, float]]:
+    """Time the XLA dot against the generated ``pallas_matmul`` tiling
+    family for the local ``a @ b`` and return ``[(candidate, seconds)]``
+    fastest-first. Default candidates come from
+    :func:`~marlin_tpu.ops.tile_family.gemm_candidates` (VMEM-pruned,
+    traffic-ranked) plus ``"xla"``; the winner is cached (memory + disk,
+    device_kind-keyed) for :func:`best_gemm`. An explicit ``candidates``
+    subset is timed without touching the cache, as in
+    :func:`tune_multiply`."""
+    from ..ops import tile_family
+    from ..ops.local import gemm as xla_gemm
+    from ..ops.pallas_kernels import pallas_matmul
+
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+        raise ValueError(f"inner dim mismatch: {a.shape} @ {b.shape}")
+    m, k = a.shape
+    n = b.shape[1]
+    item = jnp.dtype(a.dtype).itemsize
+    explicit = candidates is not None
+    if candidates is None:
+        candidates = ["xla"] + [c.name for c in
+                                tile_family.gemm_candidates(m, k, n, item)]
+
+    def run(name):
+        if name == "xla":
+            return xla_gemm(a, b)
+        t = tile_family.parse_gemm_candidate(name)
+        return pallas_matmul(a, b, bm=t.bm, bn=t.bn, bk=t.bk)
+
+    from ..obs import perf
+
+    analytic = {"flops": 2.0 * m * k * n,
+                "bytes accessed": float((m * k + k * n + m * n) * item)}
+
+    def prog_key(name):
+        return perf.program_key(candidate=name, shape=f"{m}x{k}x{n}",
+                                dtype=str(a.dtype))
+
+    results = _time_candidates("gemm", candidates, run, prog_key, analytic,
+                               reps)
+    if not explicit:
+        key = _gemm_key(m, k, n, a.dtype)
+        _CACHE[key] = results[0][0]
+        _persist(key, results[0][0])
+    return results
+
+
+def _valid_gemm_name(name) -> bool:
+    if name == "xla":
+        return True
+    try:
+        from ..ops import tile_family
+
+        tile_family.parse_gemm_candidate(name)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def best_gemm(a, b, reps: int = 3) -> str:
+    """Cached winning gemm candidate for these operands' configuration
+    (``"xla"`` or ``"pallas:BMxBNxBK"``), tuning on a miss in both cache
+    layers. Persisted names are validated before trust, exactly as
+    :func:`best_strategy` validates engine names."""
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    key = _gemm_key(a.shape[0], a.shape[1], b.shape[1], a.dtype)
+    if key not in _CACHE:
+        with _DISK_LOCK:
+            persisted = _disk_layer().get(repr(key))
+        if _valid_gemm_name(persisted):
+            _CACHE[key] = persisted
+        else:
+            tune_gemm(a, b, reps=reps)
+    return _CACHE[key]
+
+
+def _bsr_key(bsr, p: int, out_dtype) -> tuple:
+    return ("bsr", bsr.shape, bsr.block_size, bsr.nnzb, int(p),
+            str(out_dtype), *_device_sig())
+
+
+def tune_bsr(bsr, b, candidates=None, reps: int = 2) -> list[tuple[str, float]]:
+    """Time the BSR SpMM family (chunked-XLA ``chunk_blocks`` variants +
+    the Pallas kernel, :func:`~marlin_tpu.ops.tile_family.bsr_candidates`)
+    for ``bsr @ b`` and return ``[(candidate, seconds)]`` fastest-first,
+    caching the winner for :func:`best_bsr_strategy`. This is what
+    guarantees the hand-written kernel can never be dispatched where the
+    XLA formulation wins — the ranking, not a human, picks."""
+    from ..ops import tile_family
+
+    arr = jnp.asarray(b.logical() if hasattr(b, "logical") else b)
+    p = arr.shape[1] if arr.ndim == 2 else 1
+    item = jnp.dtype(arr.dtype).itemsize
+    explicit = candidates is not None
+    if candidates is None:
+        candidates = tile_family.bsr_candidates(bsr.block_size, bsr.nnzb, p,
+                                                item)
+
+    def run(name):
+        cb = tile_family.parse_bsr_candidate(name)
+        if cb is None:
+            return bsr.multiply(arr, backend="pallas")
+        return bsr.multiply(arr, chunk_blocks=cb)
+
+    from ..obs import perf
+
+    bs = bsr.block_size
+    analytic = {"flops": 2.0 * bsr.nnzb * bs * bs * p,
+                "bytes accessed": float(
+                    bsr.nnzb * (bs * bs + bs * p) * item
+                    + bsr.shape[0] * p * item)}
+
+    def prog_key(name):
+        return perf.program_key(candidate=name,
+                                shape=f"{bsr.shape[0]}x{bsr.shape[1]}",
+                                bs=bs, nnzb=bsr.nnzb, p=p)
+
+    results = _time_candidates("bsr_spmm", candidates, run, prog_key,
+                               analytic, reps)
+    if not explicit:
+        key = _bsr_key(bsr, p, arr.dtype)
+        _CACHE[key] = results[0][0]
+        _persist(key, results[0][0])
+    return results
+
+
+def _valid_bsr_name(name) -> bool:
+    try:
+        from ..ops import tile_family
+
+        tile_family.parse_bsr_candidate(name)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def best_bsr_strategy(bsr, b, reps: int = 2) -> str:
+    """Cached winning BSR candidate (``"chunked:N"`` or ``"pallas"``) for
+    this (shape, block structure, panel width, device) configuration,
+    tuning on a miss — the consultation point for
+    ``matrix/sparse.py``'s ``backend="auto"`` dispatch."""
+    arr = jnp.asarray(b.logical() if hasattr(b, "logical") else b)
+    p = arr.shape[1] if arr.ndim == 2 else 1
+    key = _bsr_key(bsr, p, arr.dtype)
+    if key not in _CACHE:
+        with _DISK_LOCK:
+            persisted = _disk_layer().get(repr(key))
+        if _valid_bsr_name(persisted):
+            _CACHE[key] = persisted
+        else:
+            tune_bsr(bsr, b, reps=reps)
     return _CACHE[key]
 
 

@@ -185,6 +185,7 @@ class ServeEngine:
                  page_len: int | None = None, num_pages: int | None = None,
                  prefill_chunk: int | None = None,
                  prefix_cache: bool | None = None,
+                 decode_kernel: str | None = None,
                  clock=time.monotonic, log=None, start: bool = True):
         cfg = get_config()
         self.params = params
@@ -207,8 +208,22 @@ class ServeEngine:
         depth = int(cfg.serve_queue_depth if queue_depth is None
                     else queue_depth)
         # --- paged-pool geometry (serving/kvpool.py) -----------------------
+        # decode-attention backend, resolved once ('auto' → pallas on TPU,
+        # gather elsewhere) so every program key / warmup / dispatch in
+        # this engine agrees on it
+        from ..models.transformer import resolve_decode_kernel
+
+        self._decode_kernel = resolve_decode_kernel(
+            cfg.serve_decode_kernel if decode_kernel is None
+            else decode_kernel)
         self._page_len = int(cfg.serve_page_len if page_len is None
                              else page_len)
+        if self.paged and self._decode_kernel == "pallas":
+            # the fused kernel streams whole pages as sublane-aligned
+            # blocks; round the page size up rather than fall back
+            from ..ops.paged_attention import align_page_len
+
+            self._page_len = align_page_len(self._page_len)
         self._prefill_chunk = int(cfg.serve_prefill_chunk
                                   if prefill_chunk is None else prefill_chunk)
         self._prefix_cache = bool(cfg.serve_prefix_cache
@@ -315,7 +330,7 @@ class ServeEngine:
             return warmup_paged(self.params, self.heads, self.buckets,
                                 self.max_batch, pool,
                                 self._prefill_chunk, self.compute_dtype,
-                                self.moe)
+                                self.moe, kernel=self._decode_kernel)
         return warmup_buckets(self.params, self.heads, self.buckets,
                               self.max_batch, self.compute_dtype, self.moe,
                               rowlevel=True)
@@ -352,7 +367,8 @@ class ServeEngine:
         if key is None:
             if self.paged:
                 key = paged_program_key(self.params, bucket, self.max_batch,
-                                        self._page_len, self.compute_dtype)
+                                        self._page_len, self.compute_dtype,
+                                        self._decode_kernel)
             else:
                 key = bucket_program_key(self.params, bucket, self.max_batch,
                                          self.compute_dtype)
@@ -1264,7 +1280,8 @@ class ServeEngine:
                     capture_paged_costs(
                         self.params, self.heads, e.bucket, self.max_batch,
                         pool, self._prefill_chunk, self.compute_dtype,
-                        self.moe, key=self._prog_key(e.bucket))
+                        self.moe, key=self._prog_key(e.bucket),
+                        kernel=self._decode_kernel)
                 slot = group.free_slots()[0]
                 n = r.prompt.shape[0]
                 shared_len, spages = pool.match_prefix(r.prompt)
@@ -1442,7 +1459,8 @@ class ServeEngine:
                     group.steps_done, group.seeds, group.temperature,
                     group.top_p, group.top_k, heads=self.heads,
                     page_len=self._page_len,
-                    compute_dtype=self.compute_dtype, moe=self.moe)
+                    compute_dtype=self.compute_dtype, moe=self.moe,
+                    kernel=self._decode_kernel)
             except Exception as exc:
                 self._fail_paged_bucket(pool, pools, bucket, exc)
                 continue

@@ -449,25 +449,38 @@ class PagedGroup:
 
 
 def paged_program_key(params: dict, bucket, max_batch: int,
-                      page_len: int, compute_dtype=None) -> str:
+                      page_len: int, compute_dtype=None,
+                      kernel: str = "gather") -> str:
     """Roofline-accounting key for one bucket's PAGED programs: the slab
     geometry joins the identity (the same bucket at a different page_len
-    compiles different programs)."""
+    compiles different programs), and so does the decode-attention backend
+    — gather vs the fused pallas kernel are different programs with
+    different rooflines. The gather default keeps pre-kernel key strings
+    (and their persisted bench anchors) unchanged."""
     from .batcher import bucket_program_key
 
-    return bucket_program_key(params, bucket, max_batch,
-                              compute_dtype) + f"/page{page_len}"
+    key = bucket_program_key(params, bucket, max_batch,
+                             compute_dtype) + f"/page{page_len}"
+    return key if kernel == "gather" else key + f"/k{kernel}"
 
 
 def capture_paged_costs(params: dict, heads: int, bucket, max_batch: int,
                         pool: PagedKVPool, prefill_chunk: int,
                         compute_dtype: str | None = None,
                         moe: tuple | None = None,
-                        key: str | None = None) -> None:
+                        key: str | None = None,
+                        kernel: str = "gather") -> None:
     """Capture the XLA cost models of a bucket's paged program pair into
     the process ProgramCosts registry — trace + lower only, gated per
     (program, key) like :func:`~.batcher.capture_bucket_costs`. Never
-    raises (observability must not fail warmup or a dispatch)."""
+    raises (observability must not fail warmup or a dispatch).
+
+    With ``kernel='pallas'`` on a Mosaic (non-interpret) lowering, the
+    pallas_call is a custom call XLA's cost analysis scores at zero — the
+    decode capture supplements the analysis with the kernel's analytic
+    cost (:func:`~marlin_tpu.ops.paged_attention.paged_attention_cost`) so
+    ``marlin_program_roofline_frac`` covers the kernel too; interpret-mode
+    lowerings are plain XLA ops and need no supplement."""
     import jax
     import jax.numpy as jnp
 
@@ -476,12 +489,13 @@ def capture_paged_costs(params: dict, heads: int, bucket, max_batch: int,
     costs = perf.get_program_costs()
     if key is None:
         key = paged_program_key(params, bucket, max_batch, pool.page_len,
-                                compute_dtype)
+                                compute_dtype, kernel)
     programs = ("lm_prefill_paged", "lm_decode_paged")
     if all(costs.tried(name, key) for name in programs):
         return
     from ..models.transformer import (_lm_decode_paged_jit,
-                                      _lm_prefill_paged_jit, init_kv_pages)
+                                      _lm_prefill_paged_jit, _n_layers,
+                                      init_kv_pages)
 
     def st(shape, dtype=jnp.int32):
         return jax.ShapeDtypeStruct(shape, dtype)
@@ -505,9 +519,27 @@ def capture_paged_costs(params: dict, heads: int, bucket, max_batch: int,
             st((max_batch,), jnp.uint32), st((max_batch,), jnp.float32),
             st((max_batch,), jnp.float32), st((max_batch,)), heads=heads,
             page_len=pool.page_len, compute_dtype=compute_dtype,
-            moe=moe).lower()
+            moe=moe, kernel=kernel).lower()
         costs.capture("lm_prefill_paged", key, lowered=pre)
-        costs.capture("lm_decode_paged", key, lowered=dec)
+        dec_cost = None
+        if kernel == "pallas":
+            from ..ops.pallas_kernels import _interpret
+            from ..ops.paged_attention import paged_attention_cost
+
+            if not _interpret():
+                d = params["emb"].shape[1]
+                dh = d // heads
+                kvh = params["l0"]["wk"].shape[1] // dh
+                slab = pages["l0"][0]
+                kc = paged_attention_cost(
+                    max_batch, g.pages_per_row, pool.page_len, kvh,
+                    heads // kvh, dh, jnp.dtype(slab.dtype).itemsize)
+                dec_cost = dict(dec.cost_analysis() or {})
+                n = _n_layers(params)
+                for field in ("flops", "bytes accessed"):
+                    dec_cost[field] = (float(dec_cost.get(field, 0.0))
+                                       + n * kc[field])
+        costs.capture("lm_decode_paged", key, lowered=dec, cost=dec_cost)
     except Exception:
         for name in programs:  # even a failed trace marks the attempt
             costs.capture(name, key)
@@ -516,7 +548,7 @@ def capture_paged_costs(params: dict, heads: int, bucket, max_batch: int,
 def warmup_paged(params: dict, heads: int, buckets, max_batch: int,
                  pool: PagedKVPool, prefill_chunk: int,
                  compute_dtype: str | None = None,
-                 moe: tuple | None = None) -> int:
+                 moe: tuple | None = None, kernel: str = "gather") -> int:
     """Compile (and execute once, against dummy page 0) every bucket's
     paged program pair plus the one shared page-copy program — ≤ 3
     programs per bucket, the whole paged compile story. Runs against the
@@ -533,7 +565,8 @@ def warmup_paged(params: dict, heads: int, buckets, max_batch: int,
     for bucket in buckets:
         g = PagedGroup(bucket, max_batch, pool.page_len, prefill_chunk)
         capture_paged_costs(params, heads, bucket, max_batch, pool,
-                            prefill_chunk, compute_dtype, moe)
+                            prefill_chunk, compute_dtype, moe,
+                            kernel=kernel)
         pool.pages, _ = lm_prefill_paged(
             params, pool.pages, np.zeros(g.table_width, np.int32),
             np.zeros(g.chunk, np.int32), 0, 1, heads=heads,
@@ -545,7 +578,7 @@ def warmup_paged(params: dict, heads: int, buckets, max_batch: int,
             np.zeros(w, np.int32), np.zeros(w, np.uint32),
             np.zeros(w, np.float32), np.ones(w, np.float32),
             np.zeros(w, np.int32), heads=heads, page_len=pool.page_len,
-            compute_dtype=compute_dtype, moe=moe)
+            compute_dtype=compute_dtype, moe=moe, kernel=kernel)
         jax.block_until_ready(nxt)
     pool.pages = kv_page_copy(pool.pages, 0, 0)  # the third program
     jax.block_until_ready(pool.pages["l0"][0])

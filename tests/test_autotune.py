@@ -219,3 +219,53 @@ def test_unknown_candidate_skipped_not_fatal(mesh):
     b = mt.DenseVecMatrix.random(31, 32, 32, mesh=mesh)
     results = mt.tune_multiply(a, b, strategies=["gspmd", "not_a_strategy"])
     assert [s for s, _ in results] == ["gspmd"]
+
+
+def test_cache_key_includes_device_kind(mesh):
+    """Winners are hardware-specific: a v5e tiling loses on a v4. The key's
+    tail must carry (platform, device_kind) so a cache file that moves
+    between machines re-tunes instead of importing the wrong winner."""
+    a = mt.DenseVecMatrix.random(70, 32, 32, mesh=mesh)
+    b = mt.DenseVecMatrix.random(71, 32, 32, mesh=mesh)
+    key = autotune._cache_key(a, b, None)
+    assert key[-2:] == autotune._device_sig()
+
+
+def test_unversioned_disk_file_ignored(mesh, monkeypatch):
+    """A pre-versioning cache file (no __version__, or the wrong one) is
+    keyed by the old device-blind scheme — the loader must drop it whole
+    and let every configuration re-tune."""
+    import json
+
+    key, a, b = _seed_cache_entry(mesh, seed=64)
+    path = mt.get_config().autotune_cache_path
+    data = json.load(open(path))
+    assert data["__version__"] == autotune._DISK_VERSION
+    del data["__version__"]  # simulate a v1-era file
+    json.dump(data, open(path, "w"))
+    _simulate_restart()
+    with autotune._DISK_LOCK:
+        assert autotune._disk_layer() == {}
+    tuned = {"n": 0}
+
+    def fake_tune(mat, other, **kw):
+        tuned["n"] += 1
+        autotune._CACHE[autotune._cache_key(mat, other, None)] = "gspmd"
+        return [("gspmd", 0.001)]
+
+    monkeypatch.setattr(autotune, "tune_multiply", fake_tune)
+    assert autotune.best_strategy(a, b) == "gspmd"
+    assert tuned["n"] == 1
+
+
+def test_version_key_survives_merge(mesh):
+    """_persist's merge-on-write keeps the file loadable: the version key
+    is re-stamped on every write, never dropped by the merge."""
+    import json
+
+    _seed_cache_entry(mesh, seed=68)
+    key2 = ("Other", (2, 2))
+    autotune._persist(key2, "rmm")
+    data = json.load(open(mt.get_config().autotune_cache_path))
+    assert data["__version__"] == autotune._DISK_VERSION
+    assert data[repr(key2)] == "rmm"

@@ -1,0 +1,330 @@
+"""Fused paged decode-attention kernel suite (interpret mode on CPU).
+
+Three layers: kernel-vs-numpy numerics (GQA head grouping, ragged per-row
+lengths, page-boundary lengths, dummy/mid-prefill rows), the greedy
+bit-identity grid across decode backends (``kernel='pallas'`` vs the
+``'gather'`` reference vs unpaged :func:`lm_generate` — the serving
+contract: swapping the attention kernel must not change a single emitted
+token), and the engine end to end with ``decode_kernel='pallas'``
+(including the ``serve_page_len`` alignment the backend forces). The
+interpret path runs the REAL kernel body — same index_map, same
+online-softmax accumulation — so these tests gate the Mosaic kernel's
+logic, not a shadow implementation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from marlin_tpu.models import TransformerLM
+from marlin_tpu.models.transformer import (init_kv_pages, lm_decode_paged,
+                                           lm_generate, lm_prefill_paged,
+                                           resolve_decode_kernel)
+from marlin_tpu.ops.paged_attention import (PAGE_SUBLANE, align_page_len,
+                                            paged_attention_cost,
+                                            paged_decode_attention)
+from marlin_tpu.serving import STATUS_OK, Request, ServeEngine
+
+PAGE_LEN = 8  # kernel-legal (multiple of PAGE_SUBLANE); tests/test_paging.py
+#               keeps exercising the 4-entry geometry on the gather path
+
+
+# ------------------------------------------------------- numpy reference
+
+
+def _ref_attention(q, k_pages, v_pages, tables, lengths):
+    """Straight-line numpy decode attention: gather each row's context by
+    block table, mask past its length, softmax, weigh V. The obvious
+    formulation the kernel must reproduce."""
+    q = np.asarray(q, np.float32)
+    kp = np.asarray(k_pages, np.float32)
+    vp = np.asarray(v_pages, np.float32)
+    B, kvh, group, dh = q.shape
+    W = tables.shape[1]
+    page_len = kp.shape[1]
+    out = np.zeros_like(q)
+    for b in range(B):
+        k = kp[tables[b]].reshape(W * page_len, kvh, dh)
+        v = vp[tables[b]].reshape(W * page_len, kvh, dh)
+        n = int(np.clip(lengths[b], 1, W * page_len))
+        s = np.einsum("kgd,tkd->kgt", q[b], k[:n]) / np.sqrt(dh)
+        s = s - s.max(axis=2, keepdims=True)
+        p = np.exp(s)
+        p = p / p.sum(axis=2, keepdims=True)
+        out[b] = np.einsum("kgt,tkd->kgd", p, v[:n])
+    return out
+
+
+def _random_case(rng, B=4, kvh=2, group=2, dh=8, W=3, num_pages=16,
+                 dtype=np.float32):
+    q = rng.standard_normal((B, kvh, group, dh)).astype(dtype)
+    kp = rng.standard_normal((num_pages, PAGE_LEN, kvh, dh)).astype(dtype)
+    vp = rng.standard_normal((num_pages, PAGE_LEN, kvh, dh)).astype(dtype)
+    # distinct live pages per row (page 0 is the pool's dummy)
+    tables = (1 + rng.permutation(num_pages - 1)[:B * W]).reshape(B, W)
+    tables = tables.astype(np.int32)
+    return q, kp, vp, tables
+
+
+def test_kernel_matches_reference_gqa_ragged():
+    """GQA (kv_heads < heads) with ragged lengths straddling page
+    boundaries: the in-place kernel matches the gathered reference."""
+    rng = np.random.default_rng(0)
+    q, kp, vp, tables = _random_case(rng)
+    lengths = np.array([1, 9, 17, 24], np.int32)  # mid-page, full-table
+    got = paged_decode_attention(q, kp, vp, tables, lengths, interpret=True)
+    want = _ref_attention(q, kp, vp, tables, lengths)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-6, rtol=2e-6)
+
+
+def test_kernel_page_boundary_lengths():
+    """Lengths landing exactly on page edges — the off-by-one hotspot for
+    the absolute-position mask ``w*page_len + t < length``."""
+    rng = np.random.default_rng(1)
+    q, kp, vp, tables = _random_case(rng)
+    for n in (PAGE_LEN - 1, PAGE_LEN, PAGE_LEN + 1, 2 * PAGE_LEN,
+              3 * PAGE_LEN):
+        lengths = np.full(4, n, np.int32)
+        got = paged_decode_attention(q, kp, vp, tables, lengths,
+                                     interpret=True)
+        want = _ref_attention(q, kp, vp, tables, lengths)
+        np.testing.assert_allclose(np.asarray(got), want, atol=2e-6,
+                                   rtol=2e-6)
+
+
+def test_kernel_dummy_rows_are_harmless():
+    """Rows still prefilling ride the batch with an all-dummy (zero) table
+    and length 1 — the dense-slab dummy-row contract. Their outputs must be
+    finite (the scheduler discards them) and must not perturb live rows."""
+    rng = np.random.default_rng(2)
+    q, kp, vp, tables = _random_case(rng)
+    lengths = np.array([12, 1, 20, 1], np.int32)
+    tables = tables.copy()
+    tables[1] = 0  # mid-prefill rows point at the dummy page
+    tables[3] = 0
+    got = np.asarray(paged_decode_attention(q, kp, vp, tables, lengths,
+                                            interpret=True))
+    assert np.isfinite(got).all()
+    want = _ref_attention(q, kp, vp, tables, lengths)
+    np.testing.assert_allclose(got[[0, 2]], want[[0, 2]], atol=2e-6,
+                               rtol=2e-6)
+
+
+def test_kernel_bf16_matches_f32_reference():
+    """bf16 q/slab run the same masked online softmax; scores and the
+    accumulator stay f32, so the error is operand rounding, not drift."""
+    rng = np.random.default_rng(3)
+    q, kp, vp, tables = _random_case(rng)
+    lengths = np.array([5, 11, 24, 16], np.int32)
+    got = paged_decode_attention(
+        jnp.asarray(q, jnp.bfloat16), jnp.asarray(kp, jnp.bfloat16),
+        jnp.asarray(vp, jnp.bfloat16), tables, lengths, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = _ref_attention(q, kp, vp, tables, lengths)
+    np.testing.assert_allclose(np.asarray(got, np.float32), want, atol=0.05,
+                               rtol=0.05)
+
+
+def test_kernel_length_clamping():
+    """Out-of-range lengths clamp to [1, W*page_len] — a row can never
+    attend past its table extent nor to zero positions."""
+    rng = np.random.default_rng(4)
+    q, kp, vp, tables = _random_case(rng)
+    wild = np.array([0, -3, 999, 24], np.int32)
+    clamped = np.array([1, 1, 24, 24], np.int32)
+    got = paged_decode_attention(q, kp, vp, tables, wild, interpret=True)
+    want = _ref_attention(q, kp, vp, tables, clamped)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-6, rtol=2e-6)
+
+
+def test_page_len_validation_and_alignment():
+    rng = np.random.default_rng(5)
+    q = rng.standard_normal((1, 2, 1, 8)).astype(np.float32)
+    bad = rng.standard_normal((4, 4, 2, 8)).astype(np.float32)  # page_len 4
+    with pytest.raises(ValueError, match="multiple of"):
+        paged_decode_attention(q, bad, bad, np.zeros((1, 2), np.int32),
+                               np.ones(1, np.int32), interpret=True)
+    assert align_page_len(1) == PAGE_SUBLANE
+    assert align_page_len(8) == 8
+    assert align_page_len(9) == 16
+    with pytest.raises(ValueError):
+        align_page_len(0)
+
+
+def test_cost_model_shape():
+    """The analytic cost dict feeds ProgramCosts.capture on the Mosaic
+    path: cost_analysis()-shaped keys, flops/bytes scale with the table."""
+    c1 = paged_attention_cost(4, 3, 8, 2, 2, 16)
+    c2 = paged_attention_cost(4, 6, 8, 2, 2, 16)
+    assert set(c1) == {"flops", "bytes accessed"}
+    assert c2["flops"] == 2 * c1["flops"]
+    assert c1["flops"] > 0 and c1["bytes accessed"] > 0
+
+
+# ------------------------------------------- backend bit-identity grid
+
+
+HEADS = 4
+KV_HEADS = 2  # GQA: 2 query heads share each K/V head
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM(vocab=32, d_model=16, heads=HEADS, layers=2,
+                         kv_heads=KV_HEADS, seed=11).init_params()
+
+
+def _ref(params, prompt, steps):
+    prompt = np.asarray(prompt, np.int32)
+    return np.asarray(lm_generate(
+        params, prompt, jax.random.key(0), heads=HEADS,
+        max_len=len(prompt) + steps, steps=steps)).tolist()
+
+
+def _prefill_row(params, pages, table, prompt):
+    """One-shot page-aligned prefill of ``prompt`` into ``table``'s pages
+    (chunk padded with zeros past the prompt, as the prefill contract
+    requires); returns ``(pages, first_token)``."""
+    n = len(prompt)
+    C = -(-n // PAGE_LEN) * PAGE_LEN
+    chunk = np.zeros(C, np.int32)
+    chunk[:n] = prompt
+    tbl = np.concatenate([np.asarray(table, np.int32), np.zeros(2, np.int32)])
+    pages, f = lm_prefill_paged(params, pages, tbl, chunk, 0, n, heads=HEADS,
+                                page_len=PAGE_LEN)
+    return pages, int(f)
+
+
+def _decode_stream(params, kernel, prompts, steps, num_pages=32):
+    """Prefill each prompt into its own pages, then run ``steps`` greedy
+    decode steps through ``lm_decode_paged`` with the chosen backend; rows
+    whose prompt is shorter keep decoding (ragged positions in one batch).
+    Returns the per-row token streams (first token + decodes)."""
+    B = len(prompts)
+    W = max((len(p) + steps + PAGE_LEN - 1) // PAGE_LEN + 1 for p in prompts)
+    pages = init_kv_pages(params, num_pages, PAGE_LEN, HEADS)
+    tables = np.zeros((B, W), np.int32)
+    first = np.zeros(B, np.int32)
+    nxt_page = 1
+    for b, prompt in enumerate(prompts):
+        need = (len(prompt) + steps + PAGE_LEN - 1) // PAGE_LEN
+        tables[b, :need] = range(nxt_page, nxt_page + need)
+        nxt_page += need
+        pages, first[b] = _prefill_row(params, pages, tables[b], prompt)
+    streams = [[int(first[b])] for b in range(B)]
+    positions = np.array([len(p) for p in prompts], np.int32)
+    cur = first.copy()
+    done = np.ones(B, np.int32)
+    z = np.zeros(B, np.int32)
+    for _ in range(steps - 1):
+        pages, nxt = lm_decode_paged(
+            params, pages, tables, positions, cur, done,
+            z.astype(np.uint32), z.astype(np.float32),
+            np.ones(B, np.float32), z, heads=HEADS, page_len=PAGE_LEN,
+            kernel=kernel)
+        nxt = np.asarray(nxt)
+        for b in range(B):
+            streams[b].append(int(nxt[b]))
+        positions += 1
+        done += 1
+        cur = nxt.astype(np.int32)
+    return streams
+
+
+def test_greedy_bit_identity_pallas_vs_gather_vs_unpaged(params):
+    """The contract: identical greedy token streams from the pallas kernel,
+    the gather reference, and unpaged lm_generate — GQA model, ragged
+    prompts (rows cross page boundaries on different steps)."""
+    prompts = [np.arange(5) % 32, np.arange(9) % 32, np.arange(12) % 32,
+               np.arange(7)[::-1] % 32]
+    steps = 8
+    gather = _decode_stream(params, "gather", prompts, steps)
+    pallas = _decode_stream(params, "pallas", prompts, steps)
+    assert pallas == gather
+    for b, prompt in enumerate(prompts):
+        assert gather[b] == _ref(params, prompt, steps)[len(prompt):]
+
+
+def test_bit_identity_with_dummy_table_rows(params):
+    """A mid-prefill row (all-zero table, the scheduler's dummy contract)
+    riding the batch must not perturb live rows' streams, under either
+    backend."""
+    prompts = [np.arange(6) % 32, np.arange(10) % 32]
+    steps = 6
+    for kernel in ("gather", "pallas"):
+        solo = _decode_stream(params, kernel, prompts, steps)
+        B = 3  # same rows + one dummy slot
+        W = (10 + steps + PAGE_LEN - 1) // PAGE_LEN + 1
+        pages = init_kv_pages(params, 32, PAGE_LEN, HEADS)
+        tables = np.zeros((B, W), np.int32)
+        first = np.zeros(B, np.int32)
+        nxt_page = 1
+        for b, prompt in enumerate(prompts):
+            need = (len(prompt) + steps + PAGE_LEN - 1) // PAGE_LEN
+            tables[b, :need] = range(nxt_page, nxt_page + need)
+            nxt_page += need
+            pages, first[b] = _prefill_row(params, pages, tables[b], prompt)
+        streams = [[int(first[b])] for b in range(2)]
+        positions = np.array([6, 10, 0], np.int32)  # row 2: dummy
+        cur = first.copy()
+        done = np.ones(B, np.int32)
+        z = np.zeros(B, np.int32)
+        for _ in range(steps - 1):
+            pages, nxt = lm_decode_paged(
+                params, pages, tables, positions, cur, done,
+                z.astype(np.uint32), z.astype(np.float32),
+                np.ones(B, np.float32), z, heads=HEADS, page_len=PAGE_LEN,
+                kernel=kernel)
+            nxt = np.asarray(nxt)
+            for b in range(2):
+                streams[b].append(int(nxt[b]))
+            positions += 1
+            done += 1
+            cur = nxt.astype(np.int32)
+        assert streams == solo
+
+
+def test_resolve_decode_kernel():
+    assert resolve_decode_kernel("gather") == "gather"
+    assert resolve_decode_kernel("pallas") == "pallas"
+    expected = "pallas" if jax.default_backend() == "tpu" else "gather"
+    assert resolve_decode_kernel("auto") == expected
+    assert resolve_decode_kernel(None) == expected  # config default: auto
+    with pytest.raises(ValueError):
+        resolve_decode_kernel("fused")
+
+
+# ------------------------------------------------------- engine end to end
+
+
+def test_engine_pallas_backend_end_to_end(params):
+    """The engine with ``decode_kernel='pallas'``: serves correct greedy
+    outputs and aligns its page geometry to the kernel's block shape."""
+    eng = ServeEngine(params, HEADS, buckets=((16, 8),), max_batch=4,
+                      queue_depth=16, page_len=6,  # NOT kernel-legal: aligns
+                      num_pages=64, decode_kernel="pallas")
+    try:
+        assert eng._page_len == 8  # align_page_len(6)
+        assert eng._decode_kernel == "pallas"
+        prompt = (np.arange(9) % 32).astype(np.int32)
+        res = eng.submit(Request(prompt=prompt, steps=5)).result(timeout=300)
+        assert res.status == STATUS_OK
+        # Result.tokens carries prompt + generated, as lm_generate returns
+        assert res.tokens.tolist() == _ref(params, prompt, 5)
+    finally:
+        eng.close()
+
+
+def test_engine_gather_backend_unchanged_geometry(params):
+    """decode_kernel='gather' keeps the configured page_len verbatim — no
+    silent geometry change for the reference path."""
+    eng = ServeEngine(params, HEADS, buckets=((16, 4),), max_batch=2,
+                      queue_depth=8, page_len=4, num_pages=64,
+                      decode_kernel="gather")
+    try:
+        assert eng._page_len == 4
+        assert eng._decode_kernel == "gather"
+    finally:
+        eng.close()

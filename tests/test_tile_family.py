@@ -1,0 +1,240 @@
+"""Generated tiling families and the family-aware autotune layer.
+
+Three layers: the pure generator (alignment, VMEM pruning, clamp-dedupe,
+analytic traffic ranking, name round-trips), numerical equivalence of every
+generated gemm candidate against the XLA reference (the family can propose
+nothing the kernel computes differently), and the measuring tuner —
+``tune_gemm``/``tune_bsr`` rank by wall time, persist winners under
+device_kind-aware keys, ``best_*`` hit the cache without re-timing, and the
+``backend="auto"`` BSR dispatch consults the ranking end to end.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import marlin_tpu as mt
+from marlin_tpu.ops import gemm
+from marlin_tpu.ops.pallas_kernels import pallas_matmul
+from marlin_tpu.ops.sparse_bsr import bsr_from_dense
+from marlin_tpu.ops.tile_family import (MXU_LANE, SUBLANE,
+                                        VMEM_BUDGET_BYTES, TileCandidate,
+                                        bsr_candidates, gemm_candidates,
+                                        gemm_traffic_bytes,
+                                        parse_bsr_candidate,
+                                        parse_gemm_candidate, vmem_bytes)
+from marlin_tpu.parallel import autotune
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache(tmp_path):
+    with mt.config_context(autotune_cache_path=str(tmp_path / "at.json")):
+        autotune.clear_cache()
+        yield
+        autotune.clear_cache()
+
+
+# ------------------------------------------------------------- generator
+
+
+def test_candidates_aligned_and_vmem_bounded():
+    """On a problem larger than every axis value, candidates keep their
+    enumerated MXU alignment and all fit the VMEM budget."""
+    cands = gemm_candidates(4096, 4096, 4096)
+    assert cands
+    for c in cands:
+        assert c.bm % SUBLANE == 0
+        assert c.bn % MXU_LANE == 0
+        assert c.bk % MXU_LANE == 0
+        assert vmem_bytes(c.bm, c.bn, c.bk) <= VMEM_BUDGET_BYTES
+
+
+def test_candidates_clamp_and_dedupe_on_small_problems():
+    """Every axis combination collapses to ONE effective tile at 128³ — the
+    clamp dedupe must never time the same compiled kernel twice."""
+    assert gemm_candidates(128, 128, 128) == [TileCandidate(128, 128, 128)]
+    # a problem below the minimum tile clamps up, still one candidate
+    assert gemm_candidates(16, 64, 64) == [TileCandidate(16, 128, 128)]
+
+
+def test_candidates_ranked_by_traffic():
+    cands = gemm_candidates(1024, 1024, 1024, max_candidates=8)
+    scores = [gemm_traffic_bytes(1024, 1024, 1024, c.bm, c.bn, c.bk)
+              for c in cands]
+    assert scores == sorted(scores)
+    assert len(cands) <= 8
+
+
+def test_degenerate_problem_rejected():
+    with pytest.raises(ValueError):
+        gemm_candidates(0, 128, 128)
+    with pytest.raises(ValueError):
+        bsr_candidates(0, 4, 32)
+
+
+def test_gemm_name_round_trip():
+    c = TileCandidate(256, 128, 512)
+    assert c.name == "pallas:256x128x512"
+    assert parse_gemm_candidate(c.name) == c
+    for junk in (None, 17, "xla", "pallas:1x2", "chunked:4"):
+        with pytest.raises(ValueError):
+            parse_gemm_candidate(junk)
+
+
+def test_bsr_name_round_trip():
+    assert parse_bsr_candidate("pallas") is None
+    assert parse_bsr_candidate("chunked:64") == 64
+    for junk in (None, 17, "pallas:128x128x128", "xla"):
+        with pytest.raises(ValueError):
+            parse_bsr_candidate(junk)
+
+
+def test_bsr_candidates_bracket_default_and_end_with_pallas():
+    cands = bsr_candidates(32, 64, 128)
+    assert cands[-1] == "pallas"
+    sizes = [parse_bsr_candidate(c) for c in cands[:-1]]
+    assert sizes == sorted(sizes)
+    assert all(1 <= s <= 64 for s in sizes)  # clamped to nnzb
+
+
+# -------------------------------------------- family vs XLA equivalence
+
+
+def test_family_candidates_match_xla_gemm():
+    """Every generated tiling computes the same product as ops.gemm — the
+    family generator can propose a tile, never a different answer."""
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((200, 160)).astype(np.float32)
+    b = rng.standard_normal((160, 260)).astype(np.float32)
+    want = np.asarray(gemm(a, b))
+    for c in gemm_candidates(200, 160, 260, max_candidates=4):
+        got = np.asarray(pallas_matmul(a, b, bm=c.bm, bn=c.bn, bk=c.bk))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------- measuring tuner
+
+
+def test_tune_gemm_ranks_and_persists():
+    rng = np.random.default_rng(8)
+    a = rng.standard_normal((256, 256)).astype(np.float32)
+    b = rng.standard_normal((256, 256)).astype(np.float32)
+    results = autotune.tune_gemm(a, b, reps=1)
+    assert len(results) >= 2 and results[0][0] != ""
+    secs = [s for _, s in results]
+    assert secs == sorted(secs)
+    names = [n for n, _ in results]
+    assert "xla" in names
+    assert any(n.startswith("pallas:") for n in names)
+    # winner cached under the device-signed key and persisted versioned
+    key = autotune._gemm_key(256, 256, 256, jnp.asarray(a).dtype)
+    assert key[-2:] == autotune._device_sig()
+    assert autotune._CACHE[key] == results[0][0]
+    disk = json.load(open(mt.get_config().autotune_cache_path))
+    assert disk["__version__"] == autotune._DISK_VERSION
+    assert disk[repr(key)] == results[0][0]
+
+
+def test_tune_gemm_explicit_candidates_do_not_pin_cache():
+    rng = np.random.default_rng(9)
+    a = rng.standard_normal((128, 128)).astype(np.float32)
+    b = rng.standard_normal((128, 128)).astype(np.float32)
+    results = autotune.tune_gemm(a, b, candidates=["xla"], reps=1)
+    assert [n for n, _ in results] == ["xla"]
+    assert len(autotune._CACHE) == 0
+
+
+def test_best_gemm_caches_without_retune(monkeypatch):
+    rng = np.random.default_rng(10)
+    a = rng.standard_normal((128, 128)).astype(np.float32)
+    b = rng.standard_normal((128, 128)).astype(np.float32)
+    first = autotune.best_gemm(a, b, reps=1)
+    assert first == "xla" or first.startswith("pallas:")
+
+    def boom(*args, **kw):
+        raise AssertionError("re-tuned a cached gemm configuration")
+
+    monkeypatch.setattr(autotune, "tune_gemm", boom)
+    assert autotune.best_gemm(a, b) == first
+
+
+def _small_bsr(rng, n=64, bs=8, p=16):
+    dense = rng.standard_normal((n, n)).astype(np.float32)
+    dense[np.abs(dense) < 1.0] = 0.0  # sparsify
+    bsr = bsr_from_dense(dense, block_size=bs)
+    b = rng.standard_normal((n, p)).astype(np.float32)
+    return bsr, b, dense
+
+
+def test_tune_bsr_ranks_family(monkeypatch):
+    rng = np.random.default_rng(11)
+    bsr, b, dense = _small_bsr(rng)
+    results = autotune.tune_bsr(bsr, b, reps=1)
+    names = [n for n, _ in results]
+    assert any(n.startswith("chunked:") for n in names)
+    secs = [s for _, s in results]
+    assert secs == sorted(secs)
+    key = autotune._bsr_key(bsr, b.shape[1], b.dtype)
+    assert autotune._CACHE[key] == results[0][0]
+
+
+def test_bsr_auto_backend_matches_dense(monkeypatch):
+    """backend='auto' consults best_bsr_strategy exactly once and computes
+    the right product whichever family member wins."""
+    rng = np.random.default_rng(12)
+    bsr, b, dense = _small_bsr(rng)
+    calls = {"n": 0}
+    orig = autotune.best_bsr_strategy
+
+    def spy(*args, **kw):
+        calls["n"] += 1
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(autotune, "best_bsr_strategy", spy)
+    out = np.asarray(bsr.multiply(b, backend="auto"))
+    assert calls["n"] == 1
+    np.testing.assert_allclose(out, dense @ b, rtol=1e-4, atol=1e-4)
+    # second multiply reuses the cached winner — no re-tune in sight
+    t_calls = {"n": 0}
+    orig_tune = autotune.tune_bsr
+
+    def tune_spy(*args, **kw):
+        t_calls["n"] += 1
+        return orig_tune(*args, **kw)
+
+    monkeypatch.setattr(autotune, "tune_bsr", tune_spy)
+    bsr.multiply(b, backend="auto")
+    assert t_calls["n"] == 0
+
+
+def test_bsr_auto_rejects_chunk_blocks():
+    rng = np.random.default_rng(13)
+    bsr, b, _ = _small_bsr(rng)
+    with pytest.raises(ValueError, match="chunk_blocks"):
+        bsr.multiply(b, backend="auto", chunk_blocks=4)
+
+
+def test_stale_persisted_family_name_triggers_retune(monkeypatch):
+    """A persisted winner whose spelling a newer tile_family no longer
+    parses must degrade to a retune, mirroring best_strategy's guard."""
+    rng = np.random.default_rng(14)
+    a = rng.standard_normal((128, 128)).astype(np.float32)
+    b = rng.standard_normal((128, 128)).astype(np.float32)
+    key = autotune._gemm_key(128, 128, 128, a.dtype)
+    autotune._persist(key, "pallas_v0:9x9")
+    autotune._CACHE.clear()
+    autotune._disk = None
+    tuned = {"n": 0}
+    orig = autotune.tune_gemm
+
+    def spy(*args, **kw):
+        tuned["n"] += 1
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(autotune, "tune_gemm", spy)
+    winner = autotune.best_gemm(a, b, reps=1)
+    assert tuned["n"] == 1
+    assert winner == "xla" or winner.startswith("pallas:")

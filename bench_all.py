@@ -745,7 +745,15 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
     through a Router over N supervised engine replicas instead of one bare
     engine — the resilience-layer A/B (records get a `_router` suffix;
     the acceptance bar is routed tok/s within 5% of the single-engine
-    baseline at the top rate). The model
+    baseline at the top rate; router records carry the fleet
+    `router_prefix_hit_rate` as a gated ride-along).
+
+    MARLIN_BENCH_REPS=N (default 1) repeats every rate N times and records
+    the median rep — serve numbers sample a live multi-threaded engine, so
+    one rep is one draw of host scheduling noise. The sweep also emits a
+    `serve_control*` record (a fixed pure-numpy matmul loop): it moves only
+    when the HOST moved, and tools/bench_compare.py downgrades serve
+    regressions that slid with it to warnings. The model
     (d_model=128, heads=8, layers=4) is sized so decode COMPUTE is
     non-trivial relative to dispatch — the serving regime; at toy sizes the
     sweep measures Python/dispatch overhead, which flatters whichever
@@ -893,23 +901,68 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
             sched += (f", prefix-cache {hits} hit / {misses} miss, "
                       f"cache-resident pages {snap.get('pages_used', 0)}"
                       f"/{snap.get('pages_total', 0)}")
+        extra = None
         if router_n:
+            # the router observability satellite (ISSUE 12): the merged
+            # snapshot spans rotated-out replicas too, so the hit rate is
+            # the fleet's — the prefix-affinity acceptance bar reads it
+            hr = snap.get("prefix_hit_rate")
             sched = (f"{router_n}-replica supervised router "
-                     f"({snap['retries']} retries), " + sched)
+                     f"({snap['retries']} retries, "
+                     f"{snap.get('migrated_in', 0)} adopted, "
+                     f"prefix-hit-rate "
+                     f"{hr if hr is not None else 'n/a'}), " + sched)
+            extra = {"router_prefix_hit_rate": hr}
         occ = snap.get("occupancy_mean", "n/a")
-        # the slab/prefix/router controls keep their own record keys so the
-        # A/B tuple coexists in BENCH_ALL.json (the merge is keyed by config)
-        record(f"serve_load{rate:g}" + suffix,
-               toks / span, "tok/s",
-               f"{len(ok)}/{n_req} ok at {rate:g} req/s offered; p50 "
-               f"{ms(lat, 50)} ms / p99 {ms(lat, 99)} ms latency; ttft p50 "
-               f"{ms(ttft, 50)} ms / p99 {ms(ttft, 99)} ms; occupancy "
-               f"{occ}, {sched}, "
-               f"warmup={'on' if warmup else 'off'}")
+        detail = (f"{len(ok)}/{n_req} ok at {rate:g} req/s offered; p50 "
+                  f"{ms(lat, 50)} ms / p99 {ms(lat, 99)} ms latency; ttft "
+                  f"p50 {ms(ttft, 50)} ms / p99 {ms(ttft, 99)} ms; "
+                  f"occupancy {occ}, {sched}, "
+                  f"warmup={'on' if warmup else 'off'}")
+        return toks / span, detail, extra
+
+    # host-drift control (ISSUE 12): a fixed pure-numpy workload no serving
+    # change can touch — when IT moves between BASE and NEW, the host was
+    # noisy and bench_compare downgrades same-direction serve regressions
+    # to warnings instead of failing the gate on machine weather
+    def run_control():
+        rng_c = np.random.default_rng(12345)
+        a = rng_c.standard_normal((256, 256))
+        reps_c = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 0.25:
+            a = a @ a.T
+            a *= 1e-3 / max(1e-9, float(abs(a).max()))
+            reps_c += 1
+        span = time.perf_counter() - t0
+        return 2 * 256**3 * reps_c / span / 1e9
+
+    # MARLIN_BENCH_REPS (ISSUE 12): median-of-N per offered rate — the
+    # serve legs measure a live multi-threaded engine on a shared host, so
+    # a single rep is one sample of the machine's mood; the median rep's
+    # (value, detail) pair is recorded whole to keep the numbers coherent
+    bench_reps = max(1, int(os.environ.get("MARLIN_BENCH_REPS", "1")))
 
     try:
         for rate in rates:
-            run_rate(rate)
+            runs = sorted((run_rate(rate) for _ in range(bench_reps)),
+                          key=lambda t: t[0])
+            val, detail, extra = runs[len(runs) // 2]
+            if bench_reps > 1:
+                detail += f"; median of {bench_reps} reps"
+            # the slab/prefix/router controls keep their own record keys so
+            # the A/B tuple coexists in BENCH_ALL.json (merge keyed by
+            # config)
+            record(f"serve_load{rate:g}" + suffix, val, "tok/s", detail,
+                   extra=extra)
+        ctrl = sorted(run_control() for _ in range(bench_reps))
+        record("serve_control" + suffix,
+               ctrl[len(ctrl) // 2], "GFLOP/s",
+               "untouched-control sentinel: fixed 256x256 numpy matmul "
+               "loop, no marlin code on the path — drift here is host "
+               "noise, and the gate warns instead of failing when serve "
+               "records move WITH it",
+               extra={"control": True})
         # ---- decode-program roofline: the serve sweep's utilization record
         # (ISSUE 6 acceptance: BENCH rounds track utilization, not just
         # tok/s). The cost model came from warmup's capture, the timings

@@ -181,6 +181,22 @@ class MarlinConfig:
     serve_default_deadline_s: float | None = None
     # Engine replicas a Router builds when none are passed explicitly.
     serve_replicas: int = 2
+    # Prefix-affine routing: requests whose prompt shares a first full KV
+    # page are rendezvous-hashed to the same ready replica, so a shared
+    # system prompt hits one replica's prefix cache instead of spraying
+    # misses across the fleet. Falls back to power-of-two-choices when the
+    # prompt has no shareable page, fewer than two replicas are ready, or
+    # the chosen replica fails the attempt. False = always power-of-two.
+    serve_prefix_affinity: bool = True
+    # How long a migration requester waits for the target worker to service
+    # a freeze/adopt/cache-warm handoff before cancelling it: rows not yet
+    # bound at the deadline fall back to the retry path (rows already bound
+    # stay adopted — never both).
+    serve_migrate_timeout_s: float = 30.0
+    # Prefix-cache chains a rebuilt replica pulls from the warmest peer
+    # after a rolling restart (hottest-first; best-effort — a failed warm
+    # never fails the restart). 0 disables cache warming.
+    serve_cache_warm_prefixes: int = 32
     # --- autotune persistence (parallel/autotune.py) -------------------------
     # Where the empirical multiply-strategy winners persist across processes.
     # None = ~/.cache/marlin_tpu/autotune.json; "" disables the disk layer

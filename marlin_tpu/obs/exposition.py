@@ -18,6 +18,10 @@ fixture watches the prefix; :meth:`close` joins it):
 - ``GET /debug/flight`` — every live flight recorder's ring as JSONL
   (:func:`marlin_tpu.obs.perf.flight_records`), the in-memory black box
   without waiting for a dump trigger.
+- ``GET /debug/kvpool`` — every registered paged engine's
+  :meth:`~marlin_tpu.serving.engine.ServeEngine.kvpool_audit` invariant
+  report as JSON (refcounts vs block tables vs free list vs prefix cache;
+  the chaos-suite postcondition, scrapeable in production).
 
 :func:`start_from_config` is the config-driven entry: it starts a server
 when ``config.obs_http_port`` is set (0 = ephemeral port), installs the
@@ -42,7 +46,9 @@ import urllib.parse
 from .metrics import MetricsRegistry, get_registry
 
 __all__ = ["MetricsServer", "start_from_config", "register_health_provider",
-           "unregister_health_provider", "health_payload"]
+           "unregister_health_provider", "health_payload",
+           "register_kvpool_provider", "unregister_kvpool_provider",
+           "kvpool_payload"]
 
 _ids = itertools.count()
 
@@ -50,6 +56,7 @@ _ids = itertools.count()
 
 _health_lock = threading.Lock()
 _health_providers: dict[str, object] = {}  # name -> callable() -> dict
+_kvpool_providers: dict[str, object] = {}  # name -> callable() -> audit dict
 
 #: provider states that flip readiness to 503 — an engine past "accepting"
 #: must drop out of rotation even while it finishes accepted work
@@ -68,6 +75,47 @@ def register_health_provider(name: str, fn) -> None:
 def unregister_health_provider(name: str) -> None:
     with _health_lock:
         _health_providers.pop(name, None)
+
+
+def register_kvpool_provider(name: str, fn) -> None:
+    """Register a paged-pool audit probe: ``fn()`` returns the engine's
+    :meth:`~marlin_tpu.serving.engine.ServeEngine.kvpool_audit` dict (or
+    None to prune itself). Paged serving engines self-register; the report
+    rides ``GET /debug/kvpool``. Re-registering a name replaces it."""
+    with _health_lock:
+        _kvpool_providers[name] = fn
+
+
+def unregister_kvpool_provider(name: str) -> None:
+    with _health_lock:
+        _kvpool_providers.pop(name, None)
+
+
+def kvpool_payload() -> tuple[int, dict]:
+    """(status_code, body) of the pool-invariant probe — 200 when every
+    registered pool audits clean, 503 when any reports a violation (an
+    inconsistent pool is as out-of-rotation as a draining engine). A
+    provider that raises reports ``ok=False``: an unanswerable audit is
+    not a clean one, but must not take the endpoint down."""
+    with _health_lock:
+        providers = dict(_kvpool_providers)
+    pools = []
+    ok = True
+    for name, fn in sorted(providers.items()):
+        try:
+            info = fn()
+            if info is None:  # provider pruned itself (e.g. GC'd engine)
+                continue
+            info = dict(info)
+        except Exception as e:
+            info = {"ok": False,
+                    "errors": [f"{type(e).__name__}: {e}"]}
+        info.setdefault("name", name)
+        if not info.get("ok", False):
+            ok = False
+        pools.append(info)
+    return (200 if ok else 503,
+            {"status": "ok" if ok else "violated", "pools": pools})
 
 
 def health_payload() -> tuple[int, dict]:
@@ -118,6 +166,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
             lines = "".join(json.dumps(r) + "\n" for r in flight_records())
             self._reply(200, lines.encode(), "application/jsonl")
+        elif path == "/debug/kvpool":
+            code, payload = kvpool_payload()
+            self._reply(code, (json.dumps(payload) + "\n").encode(),
+                        "application/json")
         else:
             self._reply(404, b"not found\n", "text/plain; charset=utf-8")
 

@@ -83,7 +83,9 @@ from ..config import get_config
 from ..obs import perf, trace as obs_trace
 from ..obs.collectors import compile_count as _compile_count
 from ..obs.exposition import (register_health_provider,
-                              unregister_health_provider)
+                              register_kvpool_provider,
+                              unregister_health_provider,
+                              unregister_kvpool_provider)
 from ..utils import faults
 from .batcher import (BatchFormer, bucket_kv_bytes, bucket_program_key,
                       capture_bucket_costs, normalize_buckets, pick_bucket,
@@ -96,7 +98,13 @@ from .request import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK,
                       STATUS_REJECTED, STATUS_SHUTTING_DOWN, AdmissionQueue,
                       Request, Result, ResultHandle)
 
-__all__ = ["ServeEngine"]
+__all__ = ["ServeEngine", "MigrationError"]
+
+
+class MigrationError(RuntimeError):
+    """A freeze/adopt handoff could not run (wrong backend or lifecycle
+    state, or the target worker did not service the request in time). The
+    router falls back to the PR 7 retry path on it."""
 
 _engine_ids = itertools.count()
 
@@ -250,7 +258,15 @@ class ServeEngine:
         self._cond = threading.Condition()
         self._former = BatchFormer(self.buckets, self.max_batch,
                                    max_wait=float(wait_ms) / 1e3)
-        self._state = "running"  # running | draining | closing | closed
+        # running | draining | freezing | frozen | closing | closed —
+        # freezing/frozen are the migration pause (freeze_rows): the worker
+        # parks leaving its pools intact and the freezing thread takes over
+        self._state = "running"
+        #: worker mailbox for cross-engine migration ops (adopt_rows /
+        #: export_prefixes / import_prefixes): (kind, payload, event, box)
+        #: tuples serviced at the top of each worker iteration — the pool
+        #: stays single-threaded, the requester waits on the event
+        self._mig_inbox: collections.deque = collections.deque()
         self._started = False
         eid = next(_engine_ids)
         self._name = f"marlin-serve-{eid}"
@@ -296,6 +312,15 @@ class ServeEngine:
             return eng._health_info()
 
         register_health_provider(name, _health)
+        if self.paged:
+            def _kvpool_report():
+                eng = ref()
+                if eng is None:
+                    unregister_kvpool_provider(name)
+                    return None
+                return eng.kvpool_audit()
+
+            register_kvpool_provider(name, _kvpool_report)
         if start:
             self.start()
 
@@ -348,6 +373,7 @@ class ServeEngine:
         Lock-free reads of GIL-atomic fields — the probe must never contend
         with the worker."""
         state = {"running": "accepting", "draining": "draining",
+                 "freezing": "draining", "frozen": "draining",
                  "closing": "closed", "closed": "closed"}[self._state]
         hb = self._heartbeat
         return {
@@ -414,6 +440,7 @@ class ServeEngine:
         except Exception:
             pass
         unregister_health_provider(self._name)
+        unregister_kvpool_provider(self._name)
 
     def _join_worker(self) -> None:
         """Join until no worker generation will run again — a supervisor
@@ -490,6 +517,7 @@ class ServeEngine:
                 self._state = "draining"
             self._cond.notify_all()
         self._join_worker()
+        self._fail_mig_inbox("engine drained before servicing migration")
         self._fail_crash_stash("serving worker died while draining")
         with self._cond:
             self._state = "closed"
@@ -518,6 +546,7 @@ class ServeEngine:
                 e.request.rid, STATUS_SHUTTING_DOWN,
                 reason="engine closed before this request was scheduled"))
         self._join_worker()
+        self._fail_mig_inbox("engine closed before servicing migration")
         self._fail_crash_stash("serving worker died; engine closed before "
                                "recovery")
         with self._cond:
@@ -678,8 +707,14 @@ class ServeEngine:
                     continue
                 seen.add(id(e))
                 undone.append(e)
-            supervised = (self._on_crash is not None
-                          and self._state in ("running", "draining"))
+            # "freezing" counts as supervised even though the supervisor
+            # idles there: freeze_rows() itself consumes the stash (the
+            # crashed rows ride the migration fallback/retry path) — an
+            # unsupervised fail-everything here would break exactly-once
+            # for rows the freeze is about to hand to another replica
+            supervised = ((self._on_crash is not None
+                           and self._state in ("running", "draining"))
+                          or self._state == "freezing")
             if supervised:
                 self._crash = (exc, undone)
                 cb = self._on_crash
@@ -1153,6 +1188,375 @@ class ServeEngine:
         deleted = getattr(pool.tokens, "is_deleted", None)
         return bool(deleted and deleted())
 
+    # ------------------------------------------------ cross-engine migration
+
+    def freeze_rows(self) -> dict | None:
+        """Pause this engine at a step boundary and take ownership of every
+        resident row for migration: admission closes, the worker parks at
+        its next iteration top (state ``freezing`` — pools left intact),
+        and the caller thread exports each row's KV pages + cursors into a
+        CRC-framed host blob (:meth:`PagedKVPool.export_rows`).
+
+        Returns ``{"engine", "blob", "entries", "queued", "fallback"}``:
+        ``entries`` maps rid → the live in-process :class:`_Entry` (handle
+        + admission reservation — both travel with the row, the blob only
+        carries device/cursor state); ``queued`` is the former backlog
+        (never started — moved wholesale, no retry twin); ``fallback`` is
+        rows that could not export (a ``serve.migrate`` export fault, or a
+        worker crash mid-freeze — the pool is not trusted after one) and
+        must ride the PR 7 retry path. Returns None when the engine cannot
+        freeze (not paged, or already terminal) — the caller falls back to
+        a plain drain. Terminal either way once it returns a dict: the
+        worker has exited and the router closes the engine next."""
+        if not self.paged:
+            return None
+        self._queue.close("engine freezing for migration")
+        with self._cond:
+            if self._state not in ("running", "draining"):
+                return None
+            self._state = "freezing"
+            self._cond.notify_all()
+        self._join_worker()
+        self._fail_mig_inbox("engine froze for migration")
+        with self._cond:
+            crash = self._crash
+            self._crash = None
+            pools = dict(self._pools)
+            pool = self._kvpool
+            queued = self._former.take_all()
+        entries: dict = {}
+        rows: list[dict] = []
+        fallback: list = []
+        seen: set[int] = set()
+
+        def _viable(e) -> bool:
+            if (e is None or id(e) in seen or e.superseded
+                    or e.handle.done()):
+                return False
+            seen.add(id(e))
+            return True
+
+        if crash is not None:
+            # the worker died mid-freeze: the pool is not trusted —
+            # export nothing, every stashed row rides the retry fallback.
+            # This is also how a dead generation's in-flight export is
+            # invalidated: its rows become fresh-attempt twins, and the
+            # stale export's entries (superseded by those twins) are
+            # skipped at adopt time
+            for e in crash[1]:
+                if _viable(e):
+                    fallback.append(e)
+        else:
+            for bucket, group in pools.items():
+                for slot in group.occupied_slots():
+                    e = group.entries[slot]
+                    if not _viable(e):
+                        continue
+                    try:
+                        faults.fire(
+                            "serve.migrate",
+                            path=f"export:{e.request.rid}@{self._name}")
+                        rows.append(self._export_row(group, bucket, slot))
+                        entries[e.request.rid] = e
+                    except Exception:
+                        fallback.append(e)
+        blob = None
+        if rows and pool is not None:
+            try:
+                blob = pool.export_rows(rows)
+                self.metrics.record_migration("export", len(rows))
+            except Exception:
+                # the blob never materialized: every exported row falls
+                # back to the retry path (its source pages die with this
+                # engine — nothing leaks into the blob's absence)
+                fallback.extend(entries.values())
+                entries = {}
+        with self._cond:
+            self._state = "frozen"
+        self._flight_dump("freeze")
+        return {"engine": self, "blob": blob, "entries": entries,
+                "queued": list(queued), "fallback": fallback}
+
+    def _export_row(self, group, bucket, slot: int) -> dict:
+        """One row's migration manifest: block table (position order),
+        cursors, host token stream, and sampling state — everything
+        :meth:`PagedGroup.restore` needs for a bit-identical resume."""
+        e = group.entries[slot]
+        return {
+            "rid": e.request.rid,
+            "bucket": [int(b) for b in bucket],
+            "prompt": np.asarray(e.request.prompt, np.int32).tolist(),
+            "pages": [int(p) for p in (group.row_pages[slot] or [])],
+            "length": int(group.lengths[slot]),
+            "position": int(group.positions[slot]),
+            "steps_done": int(group.steps_done[slot]),
+            "cur_tok": int(group.cur_tok[slot]),
+            "pf_next": int(group.pf_next[slot]),
+            "n_shared": int(group.shared_pages[slot]),
+            "emitted": [int(t) for t in (group.emitted[slot] or [])],
+            "seed": int(e.request.seed),
+            "temperature": float(group.temperature[slot]),
+            "top_p": float(group.top_p[slot]),
+            "top_k": int(group.top_k[slot]),
+            "ttft_s": group.ttft_s[slot],
+        }
+
+    def adopt_rows(self, frozen: dict, timeout: float | None = None) -> dict:
+        """Adopt a peer's frozen row set: import the blob's KV pages into
+        this engine's pool (re-deduplicating through the prefix cache) and
+        resume each row mid-stream. Runs on THIS engine's worker thread via
+        the migration mailbox — the pool stays single-threaded. Each row
+        binds under the engine lock: its admission reservation is adopted
+        (:meth:`AdmissionQueue.adopt`) at bind time and released by the
+        normal retirement path, so the reservation is carried exactly once
+        end to end (the caller releases the source's charge for adopted
+        rids). Rows whose entry was superseded or resolved while frozen
+        (a source recovery invalidated the export) are dropped with their
+        pages released. Returns ``{"adopted": [rids], "fallback":
+        [entries]}``; on a worker timeout the rows bound so far count as
+        adopted and the rest fall back — never both."""
+        entries = dict(frozen["entries"])
+        blob = frozen.get("blob")
+        if blob is None or not entries:
+            return {"adopted": [], "fallback": list(entries.values())}
+        if not self.paged:
+            raise MigrationError(
+                f"adopt target {self._name} is not a paged engine")
+        if timeout is None:
+            timeout = get_config().serve_migrate_timeout_s
+        box: dict = {"bound": [], "cancelled": False}
+        ev = threading.Event()
+        with self._cond:
+            if self._state != "running" or not self._started:
+                raise MigrationError(
+                    f"adopt target {self._name} not accepting "
+                    f"({self._state})")
+            self._mig_inbox.append(
+                ("adopt", {"blob": blob, "entries": entries}, ev, box))
+            if self._idle:
+                self._heartbeat = time.monotonic()
+            self._cond.notify_all()
+        if not ev.wait(timeout):
+            # cancel under the lock: rows not yet bound will be released
+            # by the worker when it gets there; rows already bound are
+            # this engine's responsibility now — report them adopted so
+            # the caller neither twins nor re-places them
+            with self._cond:
+                box["cancelled"] = True
+                bound = set(box["bound"])
+            return {"adopted": sorted(bound),
+                    "fallback": [e for rid, e in entries.items()
+                                 if rid not in bound]}
+        err = box.get("error")
+        if err is not None:
+            if isinstance(err, MigrationError):
+                raise err
+            raise MigrationError(
+                f"adopt failed on {self._name}: {type(err).__name__}: "
+                f"{err}") from err
+        return box["result"]
+
+    def adopt_entries(self, entries) -> bool:
+        """Queue-only handoff for migrated work WITHOUT device state — the
+        frozen backlog and retry-fallback twins. Each entry's reservation
+        is force-admitted (the fleet already admitted this work; the gate
+        bounds new admissions only) and the entry queues normally. Returns
+        False when this engine is not accepting — the caller tries the
+        next replica."""
+        entries = list(entries)
+        if not entries:
+            return True
+        with self._cond:
+            if self._state != "running":
+                return False
+            for e in entries:
+                self._queue.adopt(e.cost)
+                self._former.add(e)
+            if self._idle:
+                self._heartbeat = time.monotonic()
+            self._cond.notify_all()
+        self.metrics.record_queue(self._queue.count,
+                                  self._queue.bytes_in_flight)
+        return True
+
+    def export_prefixes(self, n: int,
+                        timeout: float | None = None) -> bytes | None:
+        """The pool's N hottest prefix-cache chains as a migration blob
+        (worker-mediated; best-effort — returns None instead of raising:
+        cache warming must never fail a restart)."""
+        if not self.paged or n <= 0:
+            return None
+        if timeout is None:
+            timeout = get_config().serve_migrate_timeout_s
+        try:
+            return self._mig_post("export_prefixes", int(n), timeout)
+        except MigrationError:
+            return None
+
+    def import_prefixes(self, blob: bytes | None,
+                        timeout: float | None = None) -> int:
+        """Warm this pool's prefix cache from a peer's exported chains
+        (worker-mediated; best-effort). Returns entries inserted."""
+        if not self.paged or not blob:
+            return 0
+        if timeout is None:
+            timeout = get_config().serve_migrate_timeout_s
+        try:
+            return int(self._mig_post("import_prefixes", blob, timeout) or 0)
+        except MigrationError:
+            return 0
+
+    def _mig_post(self, kind: str, payload, timeout: float):
+        """Post one op to the worker's migration mailbox and wait."""
+        box: dict = {"bound": [], "cancelled": False}
+        ev = threading.Event()
+        with self._cond:
+            if self._state != "running" or not self._started:
+                raise MigrationError(
+                    f"{self._name} not accepting ({self._state})")
+            self._mig_inbox.append((kind, payload, ev, box))
+            if self._idle:
+                self._heartbeat = time.monotonic()
+            self._cond.notify_all()
+        if not ev.wait(timeout):
+            with self._cond:
+                box["cancelled"] = True
+            raise MigrationError(
+                f"{kind} timed out after {timeout}s on {self._name}")
+        err = box.get("error")
+        if err is not None:
+            raise MigrationError(
+                f"{kind} failed on {self._name}: {type(err).__name__}: "
+                f"{err}") from err
+        return box.get("result")
+
+    def _service_migrations(self, pool, pools, pf_queue) -> None:
+        """Drain the migration mailbox on the worker thread (called once
+        per iteration). Any failure lands in the requester's box — the
+        worker survives every migration fault; mid-migration failure must
+        degrade to the retry path, never kill the adoptive engine."""
+        while True:
+            with self._cond:
+                if not self._mig_inbox:
+                    return
+                kind, payload, ev, box = self._mig_inbox.popleft()
+                if box.get("cancelled"):
+                    box["error"] = MigrationError("cancelled by requester")
+                    ev.set()
+                    continue
+            try:
+                if kind == "adopt":
+                    box["result"] = self._mig_adopt(pool, pools, pf_queue,
+                                                    payload, box)
+                elif kind == "export_prefixes":
+                    box["result"] = pool.export_prefixes(payload)
+                elif kind == "import_prefixes":
+                    faults.fire("serve.migrate", path=f"warm@{self._name}")
+                    n = pool.import_prefixes(payload)
+                    self._record_pages(pool)
+                    box["result"] = n
+                else:
+                    box["error"] = MigrationError(
+                        f"unknown migration op {kind!r}")
+            except BaseException as exc:
+                box["error"] = exc
+            ev.set()
+
+    def _mig_adopt(self, pool, pools, pf_queue, payload, box) -> dict:
+        """Worker-side adopt: import the blob, then bind each row under
+        the engine lock (atomic against the requester's timeout-cancel —
+        a row is either bound here exactly once or reported back for the
+        fallback path, never both)."""
+        faults.fire("serve.migrate", path=f"import@{self._name}")
+        entries = payload["entries"]
+        rows = pool.import_rows(payload["blob"])
+        adopted: list = []
+        fallback: list = []
+        for row in rows:
+            rid = row["rid"]
+            e = entries.get(rid)
+            pages = row["pages"]
+            bucket = tuple(row["bucket"])
+            group = pools.get(bucket)
+            if group is None and bucket in self.buckets:
+                group = pools[bucket] = PagedGroup(
+                    bucket, self.max_batch, self._page_len,
+                    self._prefill_chunk)
+                capture_paged_costs(
+                    self.params, self.heads, bucket, self.max_batch,
+                    pool, self._prefill_chunk, self.compute_dtype,
+                    self.moe, key=self._prog_key(bucket),
+                    kernel=self._decode_kernel)
+            bound = False
+            try:
+                faults.fire("serve.migrate",
+                            path=f"adopt:{rid}@{self._name}")
+                with self._cond:
+                    viable = (e is not None and not e.superseded
+                              and not e.handle.done()
+                              and not box.get("cancelled")
+                              and self._state == "running")
+                    free = group.free_slots() if group is not None else []
+                    if viable and free:
+                        slot = free[0]
+                        self._queue.adopt(e.cost)
+                        group.restore(slot, e, row, pages)
+                        if int(row["pf_next"]) >= 0:
+                            pf_queue.append((bucket, slot, rid))
+                        box["bound"].append(rid)
+                        bound = True
+            except Exception:
+                bound = False
+            if bound:
+                adopted.append(rid)
+                with obs_trace.use(e.trace):
+                    self.metrics.record_page_event(
+                        "adopt", rid=rid, pages=len(pages),
+                        shared=int(row["n_shared"]),
+                        used=pool.used_count(), total=pool.capacity)
+            else:
+                pool.release(pages)
+                if (e is not None and not e.superseded
+                        and not e.handle.done()):
+                    fallback.append(e)
+        if adopted:
+            self.metrics.record_migration("adopt", len(adopted))
+        self._record_pages(pool)
+        self._live_rows = sum(len(g.live_slots())
+                              for g in pools.values())
+        return {"adopted": adopted, "fallback": fallback}
+
+    def _fail_mig_inbox(self, reason: str) -> None:
+        """Resolve every pending mailbox op with an error (the worker is
+        gone — a requester blocked on its event must not wait out the
+        full timeout)."""
+        while True:
+            with self._cond:
+                if not self._mig_inbox:
+                    return
+                kind, payload, ev, box = self._mig_inbox.popleft()
+            box["error"] = MigrationError(reason)
+            ev.set()
+
+    def kvpool_audit(self) -> dict:
+        """The pool invariant report (:meth:`PagedKVPool.audit`) over this
+        engine's live groups — exact on a quiesced engine (closed, drained,
+        frozen); advisory under a running worker (the probe snapshot races
+        row transitions). Never raises — rides ``GET /debug/kvpool``."""
+        if not self.paged:
+            return {"ok": True, "errors": [], "note": "engine is not paged"}
+        with self._cond:
+            pool = self._kvpool
+            groups = list(self._pools.values())
+        if pool is None:
+            return {"ok": True, "errors": [], "note": "no pool built"}
+        try:
+            return pool.audit(groups)
+        except Exception as exc:  # racing a live worker's row transition
+            return {"ok": False,
+                    "errors": [f"audit crashed: {type(exc).__name__}: "
+                               f"{exc}"]}
+
     # --------------------------------------------------- paged scheduler
 
     def _run_paged(self, gen: int) -> None:
@@ -1194,8 +1598,15 @@ class ServeEngine:
                     while True:
                         if self._gen != gen:
                             return  # superseded by a recovery
+                        if self._state == "freezing":
+                            # migration pause: park WITHOUT touching the
+                            # pools — freeze_rows() joins this thread and
+                            # takes over every resident row
+                            return
                         busy = any(p.occupied_slots()
                                    for p in pools.values())
+                        if self._mig_inbox:
+                            break  # service migration ops outside the lock
                         if self._state == "closing":
                             # resident rows (live AND mid-prefill) are the
                             # work in flight: finish them (close() already
@@ -1225,6 +1636,7 @@ class ServeEngine:
                         # never build (or adopt) the live generation's
                         # pool
                         pool = self._ensure_kvpool()
+                self._service_migrations(pool, pools, pf_queue)
                 self._admit_paged(pool, pools, claimed, pf_queue)
                 claimed = []
                 with self._cond:

@@ -51,17 +51,81 @@ touches a pool, like :class:`~.batcher.SlotPool`).
 from __future__ import annotations
 
 import hashlib
+import json
+import struct
+import zlib
 from collections import OrderedDict
 
 import numpy as np
 
 __all__ = ["PagedKVPool", "PagedGroup", "PagePoolExhausted",
-           "auto_num_pages", "paged_program_key", "warmup_paged",
-           "capture_paged_costs"]
+           "MigrationCorruptError", "auto_num_pages", "paged_program_key",
+           "warmup_paged", "capture_paged_costs"]
 
 
 class PagePoolExhausted(RuntimeError):
     """alloc() found fewer free+evictable pages than requested."""
+
+
+class MigrationCorruptError(RuntimeError):
+    """A migration blob failed structural or CRC validation on import —
+    truncation, bit flips, or a geometry mismatch between pools. Import
+    never partially applies a corrupt blob."""
+
+
+# Migration wire format (PR 12): the MarlinChunk idiom — a flat sequence of
+# 32-byte-header chunks, each body independently CRC32-framed so a torn or
+# bit-flipped blob ALWAYS raises on import instead of resurrecting garbage
+# KV state on the target replica.
+#   header: magic "MGRT" | crc32(body) | kind | body_len | 12 pad bytes
+_MIG_MAGIC = b"MGRT"
+_MIG_HDR = struct.Struct("<4sIIQ12x")  # 32 bytes
+_MIG_META = 1      # JSON metadata (geometry + per-row/per-entry manifest)
+_MIG_ROW = 2       # one row's page contents, layers in order, k then v
+_MIG_PREFIX = 3    # prefix-cache pages (one body for the whole entry set)
+
+
+def _mig_frame(kind: int, body: bytes) -> bytes:
+    return _MIG_HDR.pack(_MIG_MAGIC, zlib.crc32(body) & 0xFFFFFFFF, kind,
+                         len(body)) + body
+
+
+def _mig_chunks(blob: bytes) -> list[tuple[int, bytes]]:
+    """Split and validate a migration blob; raises on any corruption."""
+    out = []
+    off = 0
+    n = len(blob)
+    while off < n:
+        if n - off < _MIG_HDR.size:
+            raise MigrationCorruptError(
+                f"truncated chunk header at offset {off}")
+        magic, crc, kind, length = _MIG_HDR.unpack_from(blob, off)
+        if magic != _MIG_MAGIC:
+            raise MigrationCorruptError(
+                f"bad chunk magic {magic!r} at offset {off}")
+        off += _MIG_HDR.size
+        body = blob[off:off + length]
+        if len(body) != length:
+            raise MigrationCorruptError(
+                f"truncated chunk body at offset {off}: "
+                f"need {length} bytes, have {len(body)}")
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            raise MigrationCorruptError(
+                f"chunk CRC mismatch at offset {off}")
+        out.append((kind, body))
+        off += length
+    return out
+
+
+def _mig_default(o):
+    """json.dumps default: numpy scalars/arrays from group vectors."""
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON-serializable: {type(o).__name__}")
 
 
 def _round_up(n: int, m: int) -> int:
@@ -298,6 +362,332 @@ class PagedKVPool:
         self.cow_copies += 1
         return True
 
+    # ------------------------------------------------- cross-pool migration
+
+    def _layer_names(self) -> list[str]:
+        return sorted(self.pages, key=lambda s: int(s[1:]))
+
+    def _host_pages(self) -> dict:
+        """One whole-slab device→host fetch (migration is a restart-path
+        operation; a per-row device gather would compile one program per
+        row-set size and break the bounded-compiles guarantee)."""
+        return {name: [np.array(t) for t in self.pages[name]]
+                for name in self._layer_names()}
+
+    def _flush_host(self, host) -> None:
+        """Push a host slab copy back to the device wholesale."""
+        if host is None:
+            return
+        import jax.numpy as jnp
+
+        self.pages = {name: tuple(jnp.asarray(a) for a in kv)
+                      for name, kv in host.items()}
+
+    def _geometry(self) -> dict:
+        names = self._layer_names()
+        leaf = self.pages[names[0]][0]
+        return {"page_len": self.page_len, "layers": names,
+                "dtype": str(np.dtype(leaf.dtype)),
+                "shapes": [list(np.shape(self.pages[nm][0])[1:])
+                           for nm in names]}
+
+    def _check_geometry(self, meta: dict) -> None:
+        geo = self._geometry()
+        for field in ("page_len", "layers", "dtype", "shapes"):
+            if meta.get(field) != geo[field]:
+                raise MigrationCorruptError(
+                    f"pool geometry mismatch on {field!r}: blob has "
+                    f"{meta.get(field)!r}, target pool has {geo[field]!r}")
+
+    def _row_nbytes(self, n_pages: int) -> int:
+        geo = self._geometry()
+        item = np.dtype(geo["dtype"]).itemsize
+        per_page = sum(int(np.prod([self.page_len] + shape[1:]))
+                       for shape in geo["shapes"])
+        # shapes[i] is (page_len, kvh, dh); k and v slabs per layer
+        return 2 * n_pages * per_page * item
+
+    def export_rows(self, rows) -> bytes:
+        """Serialize a row set into a CRC-framed host blob. Each element of
+        ``rows`` is a dict carrying the row's block table in position order
+        (``pages``), its prompt/cursor/sampling manifest (engine-provided;
+        travels verbatim in the meta chunk), and ``rid``. Page contents are
+        gathered device→host once for the whole set. The blob is
+        self-contained: :meth:`import_rows` on any pool with matching
+        geometry rebuilds the rows without reference to this pool."""
+        host = self._host_pages()
+        names = self._layer_names()
+        meta = {"version": 1, "kind": "rows", **self._geometry(),
+                "rows": [dict(r, pages=[int(p) for p in r["pages"]])
+                         for r in rows]}
+        blob = [_mig_frame(
+            _MIG_META, json.dumps(meta, default=_mig_default).encode())]
+        for r in meta["rows"]:
+            pids = np.asarray(r["pages"], np.int64)
+            body = b"".join(
+                np.ascontiguousarray(host[name][half][pids]).tobytes()
+                for name in names for half in (0, 1))
+            blob.append(_mig_frame(_MIG_ROW, body))
+        return b"".join(blob)
+
+    def import_rows(self, blob: bytes) -> list[dict]:
+        """Rebuild an exported row set in THIS pool: validate every chunk
+        (corruption always raises :class:`MigrationCorruptError`), then per
+        row run the NORMAL allocation path — :meth:`match_prefix` first, so
+        a migrated shared prefix re-deduplicates against the target's cache
+        (and against earlier rows of this same blob, whose completed prompt
+        pages are re-inserted as they land), then :meth:`alloc` for the
+        remainder — and scatter the imported page contents into the slab.
+        Returns the row manifests with target-space ``pages``/``n_shared``/
+        ``shared_len`` rebound; the caller binds them to entries. On any
+        failure every page this call allocated is released (pages already
+        content-written stay valid for the cache entries that reference
+        them), so a failed import leaks nothing."""
+        chunks = _mig_chunks(blob)
+        if not chunks or chunks[0][0] != _MIG_META:
+            raise MigrationCorruptError("blob does not start with a meta "
+                                        "chunk")
+        try:
+            meta = json.loads(chunks[0][1].decode())
+        except ValueError as exc:
+            raise MigrationCorruptError(f"meta chunk not JSON: {exc}")
+        if meta.get("version") != 1 or meta.get("kind") != "rows":
+            raise MigrationCorruptError(
+                f"unsupported blob version/kind: {meta.get('version')}/"
+                f"{meta.get('kind')}")
+        self._check_geometry(meta)
+        bodies = [b for kind, b in chunks[1:] if kind == _MIG_ROW]
+        if len(bodies) != len(meta["rows"]):
+            raise MigrationCorruptError(
+                f"row count mismatch: meta lists {len(meta['rows'])} rows, "
+                f"blob carries {len(bodies)} page chunks")
+        for row, body in zip(meta["rows"], bodies):
+            if len(body) != self._row_nbytes(len(row["pages"])):
+                raise MigrationCorruptError(
+                    f"row {row.get('rid')}: page payload is {len(body)} "
+                    f"bytes, expected "
+                    f"{self._row_nbytes(len(row['pages']))}")
+        names = self._layer_names()
+        dtype = np.dtype(meta["dtype"])
+        out: list[dict] = []
+        taken: list[list[int]] = []
+        host = None
+        try:
+            for row, body in zip(meta["rows"], bodies):
+                prompt = np.asarray(row["prompt"], np.int32)
+                n_pages = len(row["pages"])
+                shared_len, spages = self.match_prefix(prompt)
+                owned = self.alloc(n_pages - len(spages))
+                pages = list(spages) + owned
+                taken.append(pages)
+                if owned:
+                    if host is None:
+                        host = self._host_pages()
+                    off = 0
+                    for name, shape in zip(names, meta["shapes"]):
+                        cnt = n_pages * int(np.prod(shape))
+                        nb = cnt * dtype.itemsize
+                        for half in (0, 1):
+                            arr = np.frombuffer(
+                                body, dtype, cnt, off).reshape(
+                                    [n_pages] + shape)
+                            host[name][half][owned] = arr[len(spages):]
+                            off += nb
+                row = dict(row, pages=pages, n_shared=len(spages),
+                           shared_len=shared_len)
+                out.append(row)
+                if int(row.get("pf_next", -1)) < 0:
+                    # prefill completed on the source: publish the prompt's
+                    # full pages so later arrivals — including later rows
+                    # of this same blob — share instead of re-importing
+                    self.insert_prefix(prompt, pages)
+        except BaseException:
+            # pages already written hold valid content — flush them so any
+            # cache entry inserted above stays safe, then drop row refs
+            self._flush_host(host)
+            for pages in taken:
+                self.release(pages)
+            raise
+        self._flush_host(host)
+        return out
+
+    def export_prefixes(self, n: int) -> bytes | None:
+        """The N hottest prefix-cache entries (MRU end of the LRU order),
+        closed over their parent chains (a child without its ancestors can
+        never be matched), as a CRC-framed blob for warming a peer's cache.
+        Keys are the content hashes themselves — no prompt tokens travel.
+        Returns None when there is nothing to export."""
+        if not self.prefix_cache_enabled or not self._cache:
+            return None
+        selected: set[bytes] = set()
+        for key in list(self._cache)[-max(1, int(n)):]:
+            while key is not None and key not in selected:
+                selected.add(key)
+                key = self._cache[key].parent
+
+        def depth(k: bytes) -> int:
+            d = 0
+            e = self._cache[k]
+            while e.parent is not None:
+                d += 1
+                e = self._cache[e.parent]
+            return d
+
+        ordered = sorted(selected, key=depth)  # parents import first
+        host = self._host_pages()
+        names = self._layer_names()
+        entries = []
+        body = []
+        for key in ordered:
+            e = self._cache[key]
+            entries.append({
+                "key": key.hex(),
+                "parent": None if e.parent is None else e.parent.hex()})
+            pid = np.asarray([e.page], np.int64)
+            body.append(b"".join(
+                np.ascontiguousarray(host[name][half][pid]).tobytes()
+                for name in names for half in (0, 1)))
+        meta = {"version": 1, "kind": "prefixes", **self._geometry(),
+                "entries": entries}
+        return (_mig_frame(_MIG_META, json.dumps(meta).encode())
+                + _mig_frame(_MIG_PREFIX, b"".join(body)))
+
+    def import_prefixes(self, blob: bytes) -> int:
+        """Warm this pool's prefix cache from a peer's
+        :meth:`export_prefixes` blob: each entry allocates one page (LRU
+        eviction may make room; exhaustion stops the warm early rather than
+        failing it), takes the cache-owned reference, and links into the
+        parent chain. Entries already cached (or whose parent did not make
+        the cut) are skipped. Returns entries inserted."""
+        if not self.prefix_cache_enabled:
+            return 0
+        chunks = _mig_chunks(blob)
+        if not chunks or chunks[0][0] != _MIG_META:
+            raise MigrationCorruptError("blob does not start with a meta "
+                                        "chunk")
+        try:
+            meta = json.loads(chunks[0][1].decode())
+        except ValueError as exc:
+            raise MigrationCorruptError(f"meta chunk not JSON: {exc}")
+        if meta.get("version") != 1 or meta.get("kind") != "prefixes":
+            raise MigrationCorruptError(
+                f"unsupported blob version/kind: {meta.get('version')}/"
+                f"{meta.get('kind')}")
+        self._check_geometry(meta)
+        bodies = [b for kind, b in chunks[1:] if kind == _MIG_PREFIX]
+        body = bodies[0] if bodies else b""
+        per_entry = self._row_nbytes(1)
+        if len(body) != per_entry * len(meta["entries"]):
+            raise MigrationCorruptError(
+                f"prefix payload is {len(body)} bytes, expected "
+                f"{per_entry * len(meta['entries'])}")
+        names = self._layer_names()
+        dtype = np.dtype(meta["dtype"])
+        host = None
+        inserted = 0
+        for i, ent in enumerate(meta["entries"]):
+            key = bytes.fromhex(ent["key"])
+            parent = None if ent["parent"] is None \
+                else bytes.fromhex(ent["parent"])
+            if key in self._cache:
+                self._cache.move_to_end(key)
+                continue
+            if parent is not None and parent not in self._cache:
+                continue  # chain broken (parent evicted/skipped)
+            try:
+                page = self.alloc(1)[0]
+            except PagePoolExhausted:
+                break  # a partial warm is still a warm
+            if host is None:
+                host = self._host_pages()
+            off = i * per_entry
+            for name, shape in zip(names, meta["shapes"]):
+                cnt = int(np.prod(shape))
+                nb = cnt * dtype.itemsize
+                for half in (0, 1):
+                    host[name][half][page] = np.frombuffer(
+                        body, dtype, cnt, off).reshape(shape)
+                    off += nb
+            self._cache[key] = _CacheEntry(page, parent)
+            if parent is not None:
+                self._cache[parent].children += 1
+            inserted += 1
+        self._flush_host(host)
+        return inserted
+
+    # --------------------------------------------------------------- audit
+
+    def audit(self, groups=()) -> dict:
+        """Cross-check every pool invariant: refcounts vs block-table
+        references vs the free list vs prefix-cache ownership, the pinned
+        dummy page, and cache parent/children chain consistency. ``groups``
+        is the engine's live :class:`PagedGroup` set — row-side references
+        are only checkable when the caller passes them (chaos tests and
+        ``GET /debug/kvpool`` do). Returns ``{"ok": bool, "errors": [...],
+        **stats}``; read-only, never raises."""
+        errors: list[str] = []
+        expect = np.zeros(self.num_pages, np.int64)
+        expect[0] = 1  # the dummy pin
+        for g in groups:
+            for slot in g.occupied_slots():
+                for p in (g.row_pages[slot] or []):
+                    p = int(p)
+                    if not 0 < p < self.num_pages:
+                        errors.append(f"row table references out-of-range "
+                                      f"page {p}")
+                        continue
+                    expect[p] += 1
+        children: dict[bytes, int] = {}
+        for key, e in self._cache.items():
+            if not 0 < e.page < self.num_pages:
+                errors.append(f"cache entry references out-of-range page "
+                              f"{e.page}")
+                continue
+            expect[e.page] += 1
+            if e.parent is not None:
+                if e.parent not in self._cache:
+                    errors.append(f"cache entry for page {e.page} orphaned: "
+                                  f"parent key missing")
+                else:
+                    children[e.parent] = children.get(e.parent, 0) + 1
+        for key, e in self._cache.items():
+            want = children.get(key, 0)
+            if e.children != want:
+                errors.append(f"cache entry for page {e.page}: children "
+                              f"count {e.children} != {want} actual")
+        free = [int(p) for p in self._free]
+        fs = set(free)
+        if len(fs) != len(free):
+            errors.append("free list contains duplicate pages")
+        if 0 in fs:
+            errors.append("dummy page 0 is on the free list")
+        if int(self._ref[0]) < 1:
+            errors.append(f"dummy page 0 unpinned (refcount "
+                          f"{int(self._ref[0])})")
+        for p in fs:
+            if not 0 < p < self.num_pages:
+                errors.append(f"free list holds out-of-range page {p}")
+            elif int(self._ref[p]) != 0:
+                errors.append(f"free page {p} has refcount "
+                              f"{int(self._ref[p])}")
+            if int(expect[p]) != 0 and 0 < p < self.num_pages:
+                errors.append(f"free page {p} is still referenced by a row "
+                              f"or cache entry")
+        for p in range(1, self.num_pages):
+            ref = int(self._ref[p])
+            if p in fs:
+                continue
+            if ref == 0:
+                errors.append(f"page {p} leaked: refcount 0 but not on the "
+                              f"free list")
+            elif groups and ref != int(expect[p]):
+                errors.append(f"page {p}: refcount {ref} != "
+                              f"{int(expect[p])} referents")
+            elif not groups and ref < int(expect[p]):
+                errors.append(f"page {p}: refcount {ref} below its "
+                              f"{int(expect[p])} cache references")
+        return {"ok": not errors, "errors": errors, **self.stats()}
+
 
 class PagedGroup:
     """Per-bucket row bookkeeping over a shared :class:`PagedKVPool` — the
@@ -407,6 +797,37 @@ class PagedGroup:
         self.steps_done[slot] = 1
         self.cur_tok[slot] = first
         self.emitted[slot] = [int(first)]
+
+    def restore(self, slot: int, entry, row: dict,
+                pages: list[int]) -> None:
+        """Bind a MIGRATED row mid-stream (:meth:`PagedKVPool.import_rows`
+        manifest): like :meth:`assign` but restoring the source replica's
+        cursors — position, steps_done, current token, emitted stream, and
+        the prefill cursor for rows frozen mid-prefill. With the imported
+        KV pages in place, decode resumes bit-identically: the sampling
+        stream is ``fold_in(key(seed), step)``, composition-independent,
+        so only (seed, steps_done, KV, cur_tok) matter — all restored."""
+        r = entry.request
+        n = int(row["length"])
+        self.entries[slot] = entry
+        self.lengths[slot] = n
+        self.tables[slot, :] = 0
+        self.tables[slot, :len(pages)] = pages
+        self.row_pages[slot] = list(pages)
+        self.shared_pages[slot] = int(row["n_shared"])
+        self.pf_next[slot] = int(row["pf_next"])
+        padded = np.zeros(_round_up(n, self.chunk), np.int32)
+        padded[:n] = r.prompt
+        self.prompts[slot] = padded
+        self.positions[slot] = int(row["position"])
+        self.steps_done[slot] = int(row["steps_done"])
+        self.cur_tok[slot] = int(row["cur_tok"])
+        self.seeds[slot] = np.uint32(r.seed)
+        self.temperature[slot] = r.temperature
+        self.top_p[slot] = 1.0 if r.top_p is None else r.top_p
+        self.top_k[slot] = 0 if r.top_k is None else r.top_k
+        self.emitted[slot] = [int(t) for t in row["emitted"]]
+        self.ttft_s[slot] = row.get("ttft_s")
 
     def release(self, slot: int) -> list[int]:
         """Free the slot on ANY retirement path; returns the row's pages

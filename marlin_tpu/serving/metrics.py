@@ -136,6 +136,9 @@ class ServeMetrics:
         self.steps = 0
         self.new_tokens = 0
         self.busy_s = 0.0
+        self.migrated_out = 0   # rows exported into a migration blob
+        self.migrated_in = 0    # rows adopted mid-stream from a peer
+        self.migrate_fallback = 0  # rows that fell back to the retry path
         self._occupancy_sum = 0.0
         self._step_occupancy_sum = 0.0
         self._total_s = Reservoir(keep_latencies, rng)
@@ -194,6 +197,12 @@ class ServeMetrics:
             "marlin_serve_prefix_cache_total",
             "Prefix-cache lookups at row admission by result (hit = at "
             "least one full prompt page reused)", labelnames=("result",))
+        self._m_migrate = reg.counter(
+            "marlin_serve_migrations_total",
+            "Cross-replica row migrations by leg (export = rows serialized "
+            "off a frozen engine, adopt = rows resumed mid-stream on this "
+            "engine, fallback = rows degraded to the retry path)",
+            labelnames=("leg",))
 
     def _emit(self, **fields) -> None:
         log = self._log or get_default_event_log()
@@ -288,6 +297,23 @@ class ServeMetrics:
         self._m_retries.inc()
         self._emit(ev="retry", rid=rid, attempt=attempt,
                    max_attempts=max_attempts, reason=reason)
+
+    def record_migration(self, leg: str, rows: int) -> None:
+        """One cross-replica migration leg over ``rows`` rows: ``export``
+        (frozen rows serialized off this engine), ``adopt`` (rows resumed
+        mid-stream here), or ``fallback`` (rows degraded to the retry
+        path). Counter + one ``ev="migrate"`` EventLog record."""
+        if rows <= 0:
+            return
+        with self._lock:
+            if leg == "export":
+                self.migrated_out += rows
+            elif leg == "adopt":
+                self.migrated_in += rows
+            elif leg == "fallback":
+                self.migrate_fallback += rows
+        self._m_migrate.labels(leg=leg).inc(rows)
+        self._emit(ev="migrate", leg=leg, rows=rows)
 
     def record_pages(self, total: int, used: int, shared: int) -> None:
         """Live paged-pool state (the engine calls this after admissions,
@@ -399,6 +425,9 @@ class ServeMetrics:
                 "pages_total": self.pages_total,
                 "pages_used": self.pages_used,
                 "pages_shared": self.pages_shared,
+                "migrated_out": self.migrated_out,
+                "migrated_in": self.migrated_in,
+                "migrate_fallback": self.migrate_fallback,
                 "new_tokens": self.new_tokens,
                 "busy_s": round(self.busy_s, 6),
                 "occupancy_mean": (round(occ / dispatches, 4)

@@ -209,6 +209,17 @@ class AdmissionQueue:
             assert self._count >= 0 and self._bytes >= 0, \
                 "admission release without admit"
 
+    def adopt(self, cost_bytes: int) -> None:
+        """Force-admit a MIGRATED request's reservation (cross-engine
+        handoff): the fleet already admitted this work on the source
+        engine, whose queue is released by the migration caller — the
+        reservation moves, it is never re-judged, so depth/budget/closed
+        do not gate it (a frozen row must land even on a briefly-over-
+        budget target; the normal ``release`` path drains the charge)."""
+        with self._lock:
+            self._count += 1
+            self._bytes += cost_bytes
+
     def close(self, reason: str) -> None:
         with self._lock:
             if self._closed_reason is None:

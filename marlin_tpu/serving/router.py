@@ -6,26 +6,47 @@ One engine is one worker loop on (implicitly) one device set; the ROADMAP's
 "millions of users" story needs N of them behind one front door. A
 :class:`Router` owns that front door:
 
-- **Routing** is power-of-two-choices over the replicas that are *ready*
-  (engine accepting, supervisor breaker closed, not mid-restart): pick two
-  distinct candidates at random, route to the less loaded by the same
-  queue-depth gauge ``/metrics`` exports (``AdmissionQueue.count`` — the
-  obs-fed signal, read directly so routing needs no scrape). Two random
-  choices beat one by an exponential load-spread factor and beat
-  full-scan-least-loaded by not herding every submit onto one replica
-  between gauge updates.
+- **Routing** is prefix-affine first, power-of-two-choices otherwise
+  (``serve_prefix_affinity``): a prompt with at least one shareable KV
+  page is keyed by a hash of its FIRST full page of tokens and — once that
+  key has been seen before — rendezvous-hashed (highest-random-weight)
+  over the ready replicas, so every request sharing a system prompt lands
+  on the same replica's prefix cache — without affinity a shared prefix
+  sprays misses across the fleet and the router bench records 0 hits where
+  one engine gets 63/64. A key's FIRST occurrence routes load-aware like
+  any other request (a one-off prompt has no cache hit to win, and pinning
+  it to a hash-chosen replica regardless of queue depth measurably costs
+  tail TTFT under load); the router remembers recent keys in a small LRU
+  (:data:`_SEEN_PREFIX_CAP`) so repeat traffic engages affinity from its
+  second request on. Short prompts (nothing shareable) and degraded
+  fleets (< 2 ready) fall back to
+  power-of-two-choices over the same readiness set: pick two distinct
+  candidates at random, route to the less loaded by the same queue-depth
+  gauge ``/metrics`` exports (``AdmissionQueue.count`` — the obs-fed
+  signal, read directly so routing needs no scrape).
 - **Failover**: a replica that rejects (overload), reports shutting-down,
   or fails outright (the ``serve.router_route`` fault point simulates
   this) is skipped for this request and the remaining replicas are tried
-  in load order. Only when every replica refuses does the caller see a
-  terminal Result — deterministic, never an exception from a healthy
-  router.
-- **Rolling restart** (:meth:`rolling_restart`): one replica at a time is
-  pulled from rotation, drained (everything it accepted completes),
-  closed with its supervisor, rebuilt via the factory, and put back before
-  the next replica starts — the rest absorb traffic throughout, so a
-  fleet-wide restart drops zero requests and double-delivers none (the
-  per-engine exactly-once contract is untouched).
+  in order — the rendezvous order for affine requests (the second-highest
+  replica is every affine request's CONSISTENT fallback, so affinity
+  survives a replica failure), load order otherwise. Only when every
+  replica refuses does the caller see a terminal Result — deterministic,
+  never an exception from a healthy router.
+- **Rolling restart** (:meth:`rolling_restart`) is migrate-then-restart:
+  one replica at a time is pulled from rotation and FROZEN at a step
+  boundary (:meth:`~.engine.ServeEngine.freeze_rows`); its live rows'
+  KV pages, cursors, and sampling state are exported into a CRC-framed
+  host blob and adopted mid-stream by the least-loaded ready peer
+  (:meth:`~.engine.ServeEngine.adopt_rows` — decode continues
+  bit-identically, zero tokens re-generated), its queued backlog moves
+  wholesale, and only then is the engine closed, rebuilt via the factory,
+  its prefix cache warmed from a peer (``serve_cache_warm_prefixes``),
+  and put back before the next replica starts. Any migration leg that
+  fails (the ``serve.migrate`` fault point simulates each) degrades that
+  row to the PR 7 retry path — a fresh-attempt twin on a healthy replica,
+  reservation carried exactly once, nothing double-delivers — and a
+  replica that cannot freeze at all (slab engine) falls back to the old
+  drain-in-place rotation.
 - **One scrape target**: the router registers a single aggregated health
   provider (each adopted engine's individual provider is unregistered —
   a draining replica mid-rotation must NOT 503 the process while its
@@ -43,16 +64,21 @@ adopts existing engines but cannot rolling-restart without a factory.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
+from collections import OrderedDict
 import random
 import threading
 import time
+
+import numpy as np
 
 from ..config import get_config
 from ..obs.exposition import (register_health_provider,
                               unregister_health_provider)
 from ..obs.metrics import get_registry
 from ..utils import faults
+from .engine import MigrationError
 from .request import (STATUS_REJECTED, STATUS_SHUTTING_DOWN, Request, Result,
                       ResultHandle)
 from .supervisor import Supervisor, _emit
@@ -68,6 +94,49 @@ REPLICA_STATES = {"accepting": 0, "draining": 1, "restarting": 2,
 #: handle statuses that trigger failover to the next replica (an expired
 #: deadline is final everywhere; an error Result means the request RAN)
 _FAILOVER = (STATUS_REJECTED, STATUS_SHUTTING_DOWN)
+
+#: ServeMetrics counters a retired replica's final snapshot folds into the
+#: router's running totals at rotation — without this, every rotation
+#: silently zeroes the fleet's history (the PR 11 router bench lost its
+#: prefix hit/miss record exactly this way). Gauges (pages_*) stay
+#: current-replicas-only: a dead pool holds no pages.
+_COUNTER_KEYS = ("submitted", "rejected", "expired", "completed", "errors",
+                 "shut_down", "retries", "batches", "steps", "new_tokens",
+                 "prefix_hits", "prefix_misses", "migrated_out",
+                 "migrated_in", "migrate_fallback", "busy_s")
+
+
+def _prefix_route_key(request, ready) -> bytes | None:
+    """The affinity key: a 16-byte hash of the prompt's FIRST full KV page
+    of tokens — the same granularity the prefix cache shares at, and
+    deliberately ONLY the first page, so requests sharing a system prompt
+    map together whatever their tails do. None when nothing is shareable
+    (prompt must be strictly longer than a page: the cache never shares
+    the last-token page) or no ready replica is paged."""
+    if not get_config().serve_prefix_affinity:
+        return None
+    prompt = getattr(request, "prompt", None)
+    page_len = next((r.engine._page_len for r in ready
+                     if getattr(r.engine, "paged", False)), 0)
+    if prompt is None or not page_len or len(prompt) <= page_len:
+        return None
+    head = np.ascontiguousarray(np.asarray(prompt[:page_len], np.int32))
+    return hashlib.blake2b(head.tobytes(), digest_size=16).digest()
+
+
+#: Distinct first-page keys the router remembers for affinity gating. A
+#: shared system prompt is one key however many requests ride it, so even a
+#: small window outlives any realistic hot-prefix set; unique-prompt traffic
+#: cycles through without growing the router.
+_SEEN_PREFIX_CAP = 1024
+
+
+def _rendezvous_score(key: bytes, idx: int) -> bytes:
+    """Highest-random-weight score of (prefix key, replica): each replica
+    set change remaps only the keys that hashed to the lost/gained replica
+    — a rolling restart does not reshuffle the whole fleet's affinity."""
+    return hashlib.blake2b(key + idx.to_bytes(4, "little"),
+                           digest_size=8).digest()
 
 
 class _Replica:
@@ -88,6 +157,7 @@ class _Replica:
         if self.supervisor is not None and self.supervisor.breaker_open:
             return "failed"
         eng_state = {"running": "accepting", "draining": "draining",
+                     "freezing": "draining", "frozen": "draining",
                      "closing": "closed",
                      "closed": "closed"}[self.engine._state]
         if eng_state == "closed":
@@ -133,6 +203,8 @@ class Router:
         self._lock = threading.Lock()        # replica list + lifecycle
         self._restart_lock = threading.Lock()  # one rotation at a time
         self._closed = False
+        self._seen_prefixes = OrderedDict()  # first-page key -> True (LRU)
+        self._retired = {k: 0 for k in _COUNTER_KEYS}  # rotated-out totals
         self._name = f"marlin-router-{next(_router_ids)}"
         reg = get_registry()
         self._m_replica_state = reg.gauge(
@@ -177,12 +249,36 @@ class Router:
 
     # --------------------------------------------------------------- routing
 
-    def _candidates(self) -> list[_Replica]:
-        """Ready replicas in routing preference order: power-of-two-choices
-        first (two distinct random picks, less loaded first), then the rest
-        by load — the failover order."""
+    def _prefix_seen(self, key: bytes) -> bool:
+        """Record ``key`` in the LRU window; True iff it was already there.
+        Affinity engages only for prefixes observed more than once: the
+        first occurrence has no warm cache anywhere, so hashing it to a
+        fixed replica regardless of queue depth would trade real load
+        balance for a hit that cannot happen — exactly the tail-TTFT
+        regression the unique-prompt router bench leg caught."""
+        with self._lock:
+            seen = key in self._seen_prefixes
+            self._seen_prefixes[key] = True
+            self._seen_prefixes.move_to_end(key)
+            while len(self._seen_prefixes) > _SEEN_PREFIX_CAP:
+                self._seen_prefixes.popitem(last=False)
+        return seen
+
+    def _candidates(self, request: Request | None = None) -> list[_Replica]:
+        """Ready replicas in routing preference order. A request whose
+        shareable prefix has been seen before gets the full rendezvous
+        order over its key (affine pick first; the runner-up is the
+        consistent fallback); everything else — short prompts, first
+        touches of a new prefix — gets power-of-two-choices first (two
+        distinct random picks, less loaded first), then the rest by load.
+        Either order doubles as the failover order."""
         with self._lock:
             ready = [r for r in self._replicas if r.ready()]
+        if request is not None and len(ready) >= 2:
+            key = _prefix_route_key(request, ready)
+            if key is not None and self._prefix_seen(key):
+                return sorted(ready, reverse=True,
+                              key=lambda r: _rendezvous_score(key, r.idx))
         if len(ready) <= 2:
             return sorted(ready, key=lambda r: r.load())
         a, b = self._rng.sample(ready, 2)
@@ -193,12 +289,13 @@ class Router:
 
     def submit(self, request: Request) -> ResultHandle:
         """Route one request: exactly one terminal Result, always. Tries
-        the power-of-two pick, then fails over across every remaining
-        ready replica on rejection / shutdown / route failure; only when
-        all refuse does the caller see the last refusal (or a synthesized
-        ``rejected`` Result when no replica is ready at all)."""
+        the affine / power-of-two pick, then fails over across every
+        remaining ready replica on rejection / shutdown / route failure;
+        only when all refuse does the caller see the last refusal (or a
+        synthesized ``rejected`` Result when no replica is ready at
+        all)."""
         last = None
-        for rep in self._candidates():
+        for rep in self._candidates(request):
             try:
                 faults.fire("serve.router_route", path=f"replica-{rep.idx}")
                 h = rep.engine.submit(request)
@@ -229,11 +326,18 @@ class Router:
     # ------------------------------------------------------------- lifecycle
 
     def rolling_restart(self) -> dict:
-        """Drain-safe fleet rotation: one replica at a time leaves rotation,
-        drains (all accepted work completes), closes with its supervisor,
-        is rebuilt via the factory, and rejoins before the next leaves —
-        peers absorb traffic throughout. Returns per-replica timings.
-        Requires a factory; serialized against concurrent rotations."""
+        """Migrate-then-restart fleet rotation: one replica at a time
+        leaves rotation, its live rows are FROZEN and handed to a ready
+        peer (KV pages + cursors over the wire, decode resumes mid-stream
+        bit-identically — zero decodes restart from token 0), its queued
+        backlog moves wholesale, and only then is the engine closed,
+        rebuilt via the factory, its prefix cache warmed from a peer, and
+        rejoined before the next replica leaves — peers absorb traffic
+        throughout. A replica that cannot freeze (slab engine) falls back
+        to the PR 7 drain-in-place rotation; a migration leg that fails
+        degrades those rows to retry twins — zero dropped requests either
+        way. Returns per-replica timings. Requires a factory; serialized
+        against concurrent rotations."""
         if self._factory is None:
             raise RuntimeError("rolling_restart needs the Router built "
                                "with a factory")
@@ -248,25 +352,183 @@ class Router:
                     rep.routable = False
                 self._publish_states()
                 self._emit(ev="replica_rotate", router=self._name,
-                           replica=idx, phase="drain")
-                # drain FIRST, supervisor still attached: a worker crash
-                # mid-drain is recovered and the accepted work completes
-                # (drain's join waits out supervised recoveries) — closing
-                # the supervisor first would turn that crash into failed
-                # requests, breaking the zero-dropped rotation guarantee
-                rep.engine.drain()
+                           replica=idx, phase="migrate")
+                # supervisor still attached while we freeze: a worker
+                # crash mid-freeze is stashed (freeze_rows consumes it
+                # into the retry fallback) and the supervisor idles on the
+                # freezing/frozen states rather than respawning under us
+                if not self._migrate_out(rep):
+                    # can't freeze (slab engine / already terminal): the
+                    # PR 7 path — drain FIRST, supervisor attached, so a
+                    # crash mid-drain recovers and accepted work completes
+                    self._emit(ev="replica_rotate", router=self._name,
+                               replica=idx, phase="drain")
+                    rep.engine.drain()
                 if rep.supervisor is not None:
                     rep.supervisor.close()
                 rep.engine.close()
+                self._accumulate(rep.engine)
                 fresh = self._factory()
                 with self._lock:
                     self._replicas[idx] = self._adopt(idx, fresh)
                     self._replicas[idx].restarts = rep.restarts + 1
                 self._publish_states()
+                self._warm_replica(idx)
                 out[idx] = round(time.monotonic() - t0, 6)
                 self._emit(ev="replica_rotate", router=self._name,
                            replica=idx, phase="done", seconds=out[idx])
         return out
+
+    def _migrate_out(self, rep: _Replica) -> bool:
+        """Freeze ``rep`` and move everything it holds: live rows adopt
+        onto the least-loaded ready paged peer (KV travels, decode resumes
+        mid-stream), the queued backlog moves as-is (same entries — they
+        never started, no twin needed), and rows any leg failed on degrade
+        to fresh-attempt retry twins. Admission reservations move exactly
+        once: the target charges at bind (``AdmissionQueue.adopt``), the
+        source releases here per moved row; a row nobody can take retires
+        on the SOURCE (still charged there) so the release stays paired.
+        Returns False when the engine cannot freeze — caller drains."""
+        eng = rep.engine
+        try:
+            frozen = eng.freeze_rows()
+        except Exception as exc:
+            self._emit(ev="migrate", router=self._name, replica=rep.idx,
+                       phase="freeze_failed",
+                       reason=f"{type(exc).__name__}: {exc}")
+            return False
+        if frozen is None:
+            return False
+        entries = dict(frozen["entries"])
+        fallback = list(frozen["fallback"])
+        adopted: list = []
+        target = None
+        if frozen["blob"] is not None and entries:
+            target = self._pick_target(exclude=rep)
+            if target is None:
+                fallback.extend(entries.values())
+            else:
+                try:
+                    res = target.engine.adopt_rows(frozen)
+                    adopted = list(res["adopted"])
+                    fallback.extend(res["fallback"])
+                except MigrationError as exc:
+                    self._emit(ev="migrate", router=self._name,
+                               replica=rep.idx, target=target.idx,
+                               phase="adopt_failed",
+                               reason=f"{type(exc).__name__}: {exc}")
+                    fallback.extend(entries.values())
+        elif entries:
+            fallback.extend(entries.values())
+        # the target charged each adopted row's reservation at bind —
+        # release the source's half of the handoff
+        for rid in adopted:
+            eng._queue.release(entries[rid].cost)
+        moved_q = self._place_entries(rep, frozen["queued"], retry=False)
+        retried = self._place_entries(rep, fallback, retry=True)
+        if fallback:
+            eng.metrics.record_migration("fallback", len(fallback))
+        self._emit(ev="migrate", router=self._name, replica=rep.idx,
+                   target=target.idx if target is not None else None,
+                   adopted=len(adopted), queued_moved=moved_q,
+                   fallback=len(fallback), retried=retried)
+        return True
+
+    def _pick_target(self, exclude: _Replica) -> _Replica | None:
+        """Least-loaded ready PAGED peer — the adoption target."""
+        with self._lock:
+            cands = [r for r in self._replicas
+                     if r is not exclude and r.ready()
+                     and getattr(r.engine, "paged", False)]
+        return min(cands, key=lambda r: r.load(), default=None)
+
+    def _place_entries(self, src: _Replica, entries, retry: bool) -> int:
+        """Move queue-only work off a frozen source: each entry (or its
+        fresh-attempt twin when ``retry`` — the PR 7 contract for rows
+        whose migration failed) is force-admitted on a ready peer and the
+        source's reservation released; an entry no peer can take — or a
+        retry with no attempts left — retires on the source, whose charge
+        the retirement releases. Returns how many were placed."""
+        placed = 0
+        for e in entries:
+            if e.superseded or e.handle.done():
+                continue
+            if retry:
+                moved = e.retry()  # supersedes e; reservation carried
+                # an infrastructure-initiated restart is not the request's
+                # fault: the attempt budget charges compute faults (PR 7
+                # crash/decode retries), never a migration fallback — a
+                # max_attempts=1 request must still survive a rotation
+                moved.attempt = e.attempt
+                src.engine.metrics.record_retry(
+                    e.request.rid, moved.attempt, e.request.max_attempts,
+                    "migration fallback")
+            else:
+                moved = e
+            landed = False
+            with self._lock:
+                cands = sorted((r for r in self._replicas
+                                if r is not src and r.ready()),
+                               key=lambda r: r.load())
+            for cand in cands:
+                try:
+                    if cand.engine.adopt_entries([moved]):
+                        landed = True
+                        break
+                except Exception:
+                    continue
+            if landed:
+                src.engine._queue.release(e.cost)
+                placed += 1
+            else:
+                # nobody accepting: retire on the source, still charged
+                # there — its release pairs with the original admit
+                src.engine._retire(moved, Result(
+                    moved.request.rid, STATUS_SHUTTING_DOWN,
+                    reason="no ready replica to migrate to"))
+        return placed
+
+    def _warm_replica(self, idx: int) -> None:
+        """Warm a rebuilt replica's prefix cache from the busiest ready
+        peer's hottest chains (``serve_cache_warm_prefixes``). Entirely
+        best-effort: every failure path is a cold cache, never a failed
+        rotation."""
+        n = get_config().serve_cache_warm_prefixes
+        with self._lock:
+            fresh = self._replicas[idx]
+            peers = [r for r in self._replicas
+                     if r is not fresh and r.ready()
+                     and getattr(r.engine, "paged", False)]
+        if n <= 0 or not getattr(fresh.engine, "paged", False) or not peers:
+            return
+        # warmest peer first: the one whose cache has answered the most —
+        # affinity concentrates a shared prefix there
+        peers.sort(key=lambda r: r.engine.metrics.snapshot()["prefix_hits"],
+                   reverse=True)
+        for peer in peers:
+            try:
+                blob = peer.engine.export_prefixes(n)
+                if not blob:
+                    continue
+                got = fresh.engine.import_prefixes(blob)
+            except Exception:
+                continue
+            if got:
+                self._emit(ev="migrate", router=self._name,
+                           replica=idx, phase="cache_warm",
+                           source=peer.idx, prefixes=got)
+                return
+
+    def _accumulate(self, engine) -> None:
+        """Fold a retiring engine's final counter snapshot into the
+        router's running totals (see ``_COUNTER_KEYS``)."""
+        try:
+            snap = engine.metrics.snapshot()
+        except Exception:
+            return
+        with self._lock:
+            for k in _COUNTER_KEYS:
+                self._retired[k] += snap.get(k) or 0
 
     def drain(self) -> None:
         """Drain every replica (concurrently — they are independent) and
@@ -343,19 +605,29 @@ class Router:
         """Merged per-replica ``ServeMetrics.snapshot()`` counters plus the
         per-replica list — the router-level accounting the bench records.
         The replica list is copied under the lock so a concurrent rotation
-        cannot be read mid-swap (counters of a replica retired by the
-        rotation are gone — snapshot totals span the CURRENT engines)."""
+        cannot be read mid-swap. Counters (including the prefix hit/miss
+        pair and the migration legs) span the fleet's whole history:
+        engines retired by a rotation folded their final snapshots into
+        the router's totals at swap time. Gauges (pages_*) are
+        current-replicas-only."""
         with self._lock:
             reps = list(self._replicas)
+            retired = dict(self._retired)
         snaps = [(rep.idx, rep.engine.metrics.snapshot()) for rep in reps]
         agg: dict = {"replicas": {i: s for i, s in snaps}}
         for key in ("submitted", "rejected", "expired", "completed",
                     "errors", "shut_down", "retries", "batches", "steps",
                     "new_tokens", "prefix_hits", "prefix_misses",
-                    "pages_total", "pages_used", "pages_shared"):
+                    "migrated_out", "migrated_in", "migrate_fallback"):
+            agg[key] = (sum(s.get(key, 0) for _, s in snaps)
+                        + retired.get(key, 0))
+        for key in ("pages_total", "pages_used", "pages_shared"):
             agg[key] = sum(s.get(key, 0) for _, s in snaps)
-        busy = sum(s["busy_s"] for _, s in snaps)
+        busy = sum(s["busy_s"] for _, s in snaps) + retired.get("busy_s", 0)
         agg["busy_s"] = round(busy, 6)
         agg["tok_s"] = (round(agg["new_tokens"] / busy, 2) if busy > 0
                         else None)
+        lookups = agg["prefix_hits"] + agg["prefix_misses"]
+        agg["prefix_hit_rate"] = (round(agg["prefix_hits"] / lookups, 4)
+                                  if lookups else None)
         return agg

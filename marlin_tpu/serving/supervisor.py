@@ -164,6 +164,15 @@ class Supervisor:
         eng = self.engine
         if self.breaker_open or eng._state in ("closing", "closed"):
             return False
+        if eng._state in ("freezing", "frozen"):
+            # migration pause: the worker parks (or has parked) on purpose
+            # and freeze_rows() owns every resident row — a recovery here
+            # would respawn a generation under the migration's feet and
+            # double-deliver rows. A crash mid-freeze is stashed by the
+            # crash handler and consumed by freeze_rows() itself (those
+            # rows ride the retry fallback); keep polling — the router
+            # closes the engine when the handoff ends
+            return True
         crash = eng._crash  # read once: close()'s _fail_crash_stash may
         if crash is not None:  # null the attribute between our reads
             self._recover("worker crashed: "
@@ -179,6 +188,7 @@ class Supervisor:
         hb = eng._heartbeat
         if (self.watchdog_s > 0 and eng._started and hb is not None
                 and time.monotonic() - hb > self.watchdog_s
+                and eng._state in ("running", "draining")
                 and eng.pending() > 0):
             self._recover(f"worker stuck: heartbeat "
                           f"{time.monotonic() - hb:.1f}s old "

@@ -55,6 +55,14 @@ point               fires from
                     per replica attempt (ctx carries ``path="replica-<i>"``)
                     — a raise marks that replica failed for this request
                     and the router fails over to the next candidate
+``serve.migrate``   cross-replica KV migration, once per leg (ctx carries
+                    ``path="export:<rid>@<src>"`` per exported row,
+                    ``path="import@<target>"`` per adopted blob,
+                    ``path="adopt:<rid>@<target>"`` per row bind, and
+                    ``path="warm@<target>"`` per cache-warm import) — a
+                    raise degrades that leg to the PR 7 retry fallback:
+                    the affected rows become fresh-attempt twins, imported
+                    pages are released, exactly-once delivery holds
 ==================  =========================================================
 
 Behaviors are :class:`Fault` subclasses — :class:`RaiseFault` (raise once /
@@ -89,7 +97,7 @@ KNOWN_POINTS = frozenset({
     "ckpt.write", "ckpt.manifest", "fs.open", "fs.list", "step.run",
     "device.probe", "prefetch.produce", "dataplane.read", "serve.enqueue",
     "serve.step", "serve.prefill", "serve.decode_step", "serve.worker_crash",
-    "serve.router_route",
+    "serve.router_route", "serve.migrate",
 })
 
 

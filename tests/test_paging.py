@@ -550,6 +550,8 @@ def test_every_retirement_path_frees_pages(params):
         assert pool is not None
         assert pool.used_count() == pool.cached_count()  # rows all freed
         assert pool.shared_count() == 0
+        audit = eng.kvpool_audit()  # every invariant, not just the counts
+        assert audit["ok"], audit["errors"]
     finally:
         eng.close()
     assert eng.pending() == 0
@@ -631,6 +633,8 @@ def test_paging_soak_with_worker_kills(params):
             for t in threads:
                 t.join()
             eng.drain()
+            audit = eng.kvpool_audit()  # chaos postcondition: pages leak
+            assert audit["ok"], audit["errors"]  # nowhere, ever
         results = [h.result(timeout=600) for h in handles]
     finally:
         sup.close()

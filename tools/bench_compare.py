@@ -16,6 +16,9 @@ What counts as a regression:
 - a serve config whose ``ttft p50 N ms`` detail (bench_all embeds it in the
   record detail) rose beyond the same bound — TTFT is the serving headline
   and must not hide inside an unchanged tok/s;
+- a router config whose ``prefix-hit-rate X`` detail fell beyond tolerance
+  when both sides carry it — routing that stops landing shared prefixes on
+  the warm replica regresses cost per token long before tok/s notices;
 - a ``*_FAILED`` error record in NEW with no counterpart in BASE (a config
   that used to run and now crashes is the worst regression of all);
 - a config present in BASE but missing from NEW is *reported* (dropped)
@@ -24,6 +27,14 @@ What counts as a regression:
 ``roofline_frac`` (bench_all's utilization ride-along) is shown when either
 side carries it, informational only: utilization explains a throughput
 regression, it does not define one.
+
+**Host-drift sentinel**: records flagged ``"control": true`` (bench_all's
+``serve_control*`` — a fixed pure-numpy workload no repo change can touch)
+are never gated themselves. When a control present on BOTH sides fell
+beyond tolerance, the host itself got slower between the two runs, and
+every speed regression that moved *with* it is downgraded to
+``WARN(host-drift)`` — reported, not failed. Accuracy configs (``rel
+err``) are never downgraded: machine weather does not change arithmetic.
 
 Per-config overrides: ``--threshold serve_load64=0.1`` (repeatable) tightens
 or loosens one config without moving the global ``--tolerance``.
@@ -55,6 +66,12 @@ LOWER_BETTER = {"ms", "s", "ms/iter", "s/sweep", "rel err"}
 INFORMATIONAL = {"frac"}
 
 _TTFT_RE = re.compile(r"ttft p50 (\d+(?:\.\d+)?) ms")
+_HIT_RE = re.compile(r"prefix-hit-rate (\d+(?:\.\d+)?)")
+
+#: units a slower *host* explains — eligible for the control-sentinel
+#: downgrade; accuracy ("rel err") is excluded on purpose
+_HOST_SENSITIVE = {"GFLOP/s", "tok/s", "ktok/s", "steps/s", "ms", "s",
+                   "ms/iter", "s/sweep"}
 
 
 def load(path: str) -> dict[str, dict]:
@@ -71,9 +88,46 @@ def _ttft_ms(rec: dict) -> float | None:
     return float(m.group(1)) if m else None
 
 
+def _hit_rate(rec: dict) -> float | None:
+    # the structured ride-along when present, the detail string otherwise
+    # (BASE files from earlier rounds predate the extra field)
+    v = rec.get("router_prefix_hit_rate")
+    if isinstance(v, (int, float)):
+        return float(v)
+    m = _HIT_RE.search(str(rec.get("detail", "")))
+    return float(m.group(1)) if m else None
+
+
+def _is_control(name: str, rec: dict) -> bool:
+    return bool(rec.get("control")) or "_control" in name \
+        or name.endswith("control")
+
+
 def _frac(rec: dict):
     v = rec.get("roofline_frac")
     return f"{v:.3f}" if isinstance(v, (int, float)) else ""
+
+
+def host_drift(base: dict[str, dict], new: dict[str, dict],
+               tolerance: float) -> float | None:
+    """Worst fractional slide across control sentinels present on both
+    sides, or None when no pair exists / none slid beyond tolerance. A
+    negative return means the host got at least that much slower."""
+    worst = None
+    for name, b in base.items():
+        n = new.get(name)
+        if n is None or not _is_control(name, b):
+            continue
+        try:
+            bv, nv = float(b["value"]), float(n["value"])
+        except (TypeError, ValueError):
+            continue
+        if bv <= 0:
+            continue
+        delta = (nv - bv) / bv
+        if delta < -tolerance and (worst is None or delta < worst):
+            worst = delta
+    return worst
 
 
 def compare(base: dict[str, dict], new: dict[str, dict],
@@ -82,6 +136,7 @@ def compare(base: dict[str, dict], new: dict[str, dict],
     """Rows ``(config, base_str, new_str, delta_str, unit, status, note)``
     plus the overall regressed flag."""
     thresholds = thresholds or {}
+    drift = host_drift(base, new, tolerance)
     rows, regressed = [], False
     for name in sorted(set(base) | set(new)):
         b, n = base.get(name), new.get(name)
@@ -102,6 +157,12 @@ def compare(base: dict[str, dict], new: dict[str, dict],
         if unit == "error":
             # failed on both sides: broken, but not newly broken
             rows.append((name, bv, nv, "", unit, "still-failing", ""))
+            continue
+        if _is_control(name, n):
+            # the sentinel measures the host, not the repo — never gated
+            delta = (nv - bv) / abs(bv) if bv else 0.0
+            rows.append((name, bv, nv, f"{delta * 100:+.1f}%", unit,
+                         "control", ""))
             continue
         if unit in INFORMATIONAL:
             delta = (nv - bv) / abs(bv) if bv else 0.0
@@ -128,6 +189,25 @@ def compare(base: dict[str, dict], new: dict[str, dict],
             status = "REGRESSION"
             note = (note + " " if note else "") + \
                 f"ttft p50 {bt:.0f}->{nt:.0f} ms"
+        # the router prefix-affinity leg: higher-better hit rate gated
+        # only when both sides report it (pre-affinity BASE files don't)
+        bh, nh = _hit_rate(b), _hit_rate(n)
+        hit_bad = bh is not None and nh is not None and bh > 0 \
+            and nh < bh * (1 - tol)
+        if hit_bad:
+            bad = True
+            status = "REGRESSION"
+            note = (note + " " if note else "") + \
+                f"prefix-hit-rate {bh:.3f}->{nh:.3f}"
+        if bad and drift is not None and unit in _HOST_SENSITIVE \
+                and not hit_bad:
+            # the control slid with the candidate: machine weather, not a
+            # code regression — report loudly, fail nothing (a hit-rate
+            # drop is a routing property and is never weather)
+            status = "WARN(host-drift)"
+            note = (note + " " if note else "") + \
+                f"control slid {drift * 100:+.1f}%"
+            bad = False
         if bad:
             regressed = True
         rows.append((name, bv, nv, delta_str, unit, status, note))

@@ -22,6 +22,15 @@ serve-gate:
 ooc-gate:
 	$(MAKE) -C tools ooc-gate
 
+# repo-aware static analysis (tools/analyze; docs/static_analysis.md):
+#   make analyze / make analyze-gate
+#   make analyze BASELINE=update REASON='why'
+analyze:
+	$(MAKE) -C tools analyze
+
+analyze-gate:
+	$(MAKE) -C tools analyze-gate
+
 # build the .mchunk sidecar for a data file (native binary data plane):
 #   make chunkstore SRC=path/to/matrix.txt
 # auto-detects text vs idx3 from the name; more knobs via
@@ -36,4 +45,5 @@ tier1:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: check native serve-gate ooc-gate chunkstore tier1
+.PHONY: check native serve-gate ooc-gate analyze analyze-gate chunkstore \
+	tier1

@@ -43,4 +43,7 @@ def fuse(fn=None, **jit_kwargs):
     """
     if fn is None:
         return functools.partial(fuse, **jit_kwargs)
+    # analyze: ignore[recompile] — fuse IS the jit-creation API (a thin
+    # alias); its call sites own the caching discipline and the recompile
+    # check sees each of them directly
     return jax.jit(fn, **jit_kwargs)

@@ -38,7 +38,29 @@ import sys
 
 from .metrics import percentile
 
-__all__ = ["load_events", "trace_join", "analyze", "main"]
+__all__ = ["load_events", "trace_join", "analyze", "main",
+           "KNOWN_KINDS", "KNOWN_SERVE_EVS"]
+
+#: every EventLog record kind the package emits — the post-mortem
+#: vocabulary this analyzer understands. Kinds without a dedicated section
+#: still render through the generic per-kind latency table, but they must
+#: be declared here: an undeclared kind is a black-box stream, and the
+#: static analyzer (tools/analyze, doc-sync check) fails the gate on any
+#: emission site this set does not cover.
+KNOWN_KINDS = frozenset({
+    "ckpt", "compile", "flight", "memory", "prefetch", "profile",
+    "program", "resume", "resume_skip", "retry", "retry_deadline",
+    "retry_exhausted", "serve", "stage_times", "step_failure", "timer",
+})
+
+#: the ``ev=`` discriminators of ``kind="serve"`` records (the
+#: serving/metrics.py table plus the supervisor/router resilience events).
+#: Same contract: emitting a serve ev missing here fails the doc-sync gate.
+KNOWN_SERVE_EVS = frozenset({
+    "breaker", "enqueue", "migrate", "page", "prefill", "reject",
+    "replica_rotate", "restart", "result", "retry", "route_failover",
+    "step",
+})
 
 
 def load_events(path: str) -> tuple[list[dict], int]:

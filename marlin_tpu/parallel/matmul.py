@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import get_config
 from ..mesh import default_mesh, pad_to_multiple
+from ..utils.compat import shard_map
 from .carma import split_method
 
 _M, _K, _N = "m", "k", "n"
@@ -96,7 +97,7 @@ def _rmm_fn(mesh3: Mesh, precision: str, accum_dtype):
 
     @jax.jit
     def f(a, b):
-        return jax.shard_map(
+        return shard_map(
             local,
             mesh=mesh3,
             in_specs=(P(_M, _K), P(_K, _N)),
@@ -127,7 +128,16 @@ def rmm_matmul(
         raise ValueError(f"inner dimensions mismatch: {a.shape} @ {b.shape}")
     devs = list(devices) if devices is not None else jax.devices()
     if split is None:
-        split = split_method(m, k, n, len(devs))
+        # CARMA device budget: with no explicit device list, the
+        # default_parallelism knob caps the heuristic (the reference's
+        # spark.default.parallelism hint, MTUtils.scala:496-502) — the
+        # mesh then uses a device subset, never more than exist
+        budget = len(devs)
+        if devices is None:
+            hint = get_config().default_parallelism
+            if hint:
+                budget = max(1, min(int(hint), budget))
+        split = split_method(m, k, n, budget)
     mesh3 = build_rmm_mesh(split, devs)
     pm, pk, pn = split
     mp, kp, np_ = pad_to_multiple(m, pm), pad_to_multiple(k, pk), pad_to_multiple(n, pn)
@@ -290,7 +300,7 @@ def _fused_fn(
             b = jnp.pad(b_pad[:k, :n], ((0, kp_r - k), (0, np_r - n)))
             a = jax.lax.with_sharding_constraint(a, sh_a)
             b = jax.lax.with_sharding_constraint(b, sh_b)
-            c = jax.shard_map(
+            c = shard_map(
                 local, mesh=mesh3,
                 in_specs=(P(_M, _K), P(_K, _N)), out_specs=P(_M, _N),
             )(a, b)

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..mesh import ROWS, default_mesh, pad_to_multiple
+from ..utils.compat import pcast, shard_map
 
 __all__ = ["ring_attention", "attention_reference"]
 
@@ -95,7 +96,7 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
     perm = [(j, (j + 1) % p_size) for j in range(p_size)]
 
     def _var(t):
-        return jax.lax.pcast(t, (axis,), to="varying")
+        return pcast(t, (axis,), to="varying")
 
     def _flash_state(q_blk, k_blk, v_blk, valid_len):
         from ..ops.flash_attention import flash_attention_panel
@@ -258,7 +259,7 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
         # check_vma off on the flash path: the pallas interpreter's block
         # slicing mixes varying and invariant operands, which the vma checker
         # rejects (the XLA path keeps full checking)
-        return jax.shard_map(
+        return shard_map(
             fn,
             mesh=mesh,
             in_specs=(P(axis, None), P(axis, None), P(axis, None), P()),
@@ -271,13 +272,13 @@ def _ring_attn_fn(mesh: Mesh, axis: str, causal: bool, scale: float,
         return jax.jit(xla_call)
 
     flash_call = shard_mapped(local_flash, False)
-    flash_fwd_call = jax.shard_map(
+    flash_fwd_call = shard_map(
         local_flash_fwd, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(axis, None), P()),
         out_specs=(P(axis, None), P(axis)),  # lse rows are 1-D (see fwd)
         check_vma=False,
     )
-    flash_bwd_call = jax.shard_map(
+    flash_bwd_call = shard_map(
         local_flash_bwd, mesh=mesh,
         in_specs=(P(axis, None),) * 4 + (P(axis), P(axis, None), P()),
         out_specs=(P(axis, None),) * 3,

@@ -406,6 +406,9 @@ class ServeEngine:
         admission) and rebuilt zeroed after a recovery or slab loss."""
         pool = self._kvpool
         if pool is None:
+            # analyze: single-writer — the pool pointer belongs to the live
+            # scheduler generation; _recover/close swap it only after the
+            # worker they superseded has stopped dispatching
             pool = self._kvpool = PagedKVPool(
                 self.params, self.heads, self._num_pages, self._page_len,
                 self.compute_dtype, self._prefix_cache)
@@ -757,6 +760,10 @@ class ServeEngine:
                 # single post-recovery straggler would poison the estimate)
                 svc = max(total - (result.metrics.get("queue_s") or 0.0),
                           0.0)
+                # analyze: single-writer — advisory latency estimate for
+                # deadline admission; a lost EWMA update skews one sample,
+                # never correctness, and taking the engine lock on the
+                # retire path would order it against the submit path
                 self._service_ewma = (svc if self._service_ewma == 0.0
                                       else 0.8 * self._service_ewma
                                       + 0.2 * svc)
@@ -796,6 +803,9 @@ class ServeEngine:
         try:
             while True:
                 if self._gen == gen:  # a superseded straggler must never
+                    # analyze: single-writer — generation-guarded monotonic
+                    # stamp; floats assign atomically under the GIL and the
+                    # watchdog tolerates any interleaving
                     self._heartbeat = time.monotonic()  # fake a live pulse
                 faults.fire("serve.worker_crash",
                             path=threading.current_thread().name)
@@ -959,6 +969,9 @@ class ServeEngine:
             launched.append((bucket, pool, live, t0, nxt))
         for bucket, pool, live, t0, nxt in launched:
             try:
+                # analyze: ignore[host-sync] — THE one intentional sync per
+                # decode step: the host must see the emitted tokens to
+                # retire rows (all dispatches above launched async first)
                 nxt = np.asarray(nxt)  # sync; the per-row emitted tokens
             except Exception as exc:
                 self._fail_pool(pools, bucket, exc)
@@ -977,8 +990,11 @@ class ServeEngine:
                 pool.steps_done[i] += 1
                 r = pool.entries[i].request
                 if ((r.eos is not None and int(nxt[i]) == r.eos)
+                        # analyze: ignore[host-sync] — host numpy bookkeeping
                         or int(pool.steps_done[i]) >= r.steps):
                     if host_tokens is None:
+                        # analyze: ignore[host-sync] — one slab fetch
+                        # amortized over every row this step retires
                         host_tokens = np.asarray(pool.tokens)
                     self._retire_row(pool, i, STATUS_OK, now,
                                      host_tokens=host_tokens)
@@ -1175,6 +1191,9 @@ class ServeEngine:
             with obs_trace.use(t.trace):
                 self.metrics.record_retry(t.request.rid, t.attempt,
                                           t.request.max_attempts, reason)
+        # analyze: single-writer — a progress gauge for the watchdog, owned
+        # by the live scheduler generation; _recover zeroes it only after
+        # the generation it superseded stopped (int stores are atomic)
         self._live_rows = 0
         if alive and started:
             self._thread.start()
@@ -1590,6 +1609,9 @@ class ServeEngine:
         try:
             while True:
                 if self._gen == gen:  # a superseded straggler must never
+                    # analyze: single-writer — generation-guarded monotonic
+                    # stamp; floats assign atomically under the GIL and the
+                    # watchdog tolerates any interleaving
                     self._heartbeat = time.monotonic()  # fake a live pulse
                 faults.fire("serve.worker_crash",
                             path=threading.current_thread().name)
@@ -1756,7 +1778,9 @@ class ServeEngine:
         with obs_trace.use(e.trace):
             r = e.request
             p, s = bucket
+            # analyze: ignore[host-sync] — host numpy bookkeeping arrays
             cs = int(group.pf_next[slot])
+            # analyze: ignore[host-sync] — host numpy bookkeeping arrays
             n = int(group.lengths[slot])
             C = group.chunk
             tokens = min(C, n - cs)
@@ -1860,6 +1884,8 @@ class ServeEngine:
             try:
                 for i in live:  # COW gate on each row's write page
                     self._cow(pool, group, slot=i,
+                              # analyze: ignore[host-sync] — host numpy
+                              # block-table bookkeeping, not device data
                               table_idx=int(group.positions[i])
                               // self._page_len,
                               rid=group.entries[i].request.rid)
@@ -1880,6 +1906,9 @@ class ServeEngine:
             launched.append((bucket, group, live, t0, nxt))
         for bucket, group, live, t0, nxt in launched:
             try:
+                # analyze: ignore[host-sync] — THE one intentional sync per
+                # decode step: the host must see the emitted tokens to
+                # retire rows (all dispatches above launched async first)
                 nxt = np.asarray(nxt)  # sync; the per-row emitted tokens
             except Exception as exc:
                 self._fail_paged_bucket(pool, pools, bucket, exc)
@@ -1903,6 +1932,7 @@ class ServeEngine:
                 group.emitted[i].append(tok)
                 r = group.entries[i].request
                 if ((r.eos is not None and tok == r.eos)
+                        # analyze: ignore[host-sync] — host numpy bookkeeping
                         or int(group.steps_done[i]) >= r.steps):
                     self._retire_row_paged(pool, pools, bucket, i,
                                            STATUS_OK, now)

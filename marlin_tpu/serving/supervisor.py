@@ -231,6 +231,8 @@ class Supervisor:
         fails with ``error``, queued requests retire with clean
         ``shutting_down`` Results — nothing is stranded, and nothing
         restarts again."""
+        # analyze: single-writer — a monotonic one-way latch (never reset);
+        # readers tolerate a stale False for one poll interval
         self.breaker_open = True
         self._m_breaker.set(1)
         _emit(self._log, ev="breaker", engine=self.engine._name,

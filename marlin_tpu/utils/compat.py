@@ -1,0 +1,105 @@
+"""JAX version compatibility shims.
+
+The multi-device modules are written against the modern ``jax.shard_map``
+API (top-level export, ``check_vma=``, varying-manual-axes types and
+``jax.lax.pcast``). Older jax releases (e.g. 0.4.x, where the CPU CI
+container sits) carry the same functionality as
+``jax.experimental.shard_map.shard_map`` with ``check_rep=`` and no
+varying types at all. Importing — and pytest-collecting — a module must
+never depend on which era of jax is installed, so every shard_map user
+routes through this module instead of touching ``jax.shard_map`` at
+attribute-lookup time:
+
+    from ..utils.compat import shard_map, pcast
+    f = shard_map(local, mesh=mesh, in_specs=..., out_specs=...,
+                  check_vma=False)
+
+On a jax with neither API the wrapper raises ``ShardMapUnavailable``
+(a ``NotImplementedError``) at *call* time with an actionable message —
+analysis and collection of the importing file degrade to a skip, not an
+import error.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+__all__ = ["HAS_SHARD_MAP", "ShardMapUnavailable", "shard_map", "pcast",
+           "vma_of", "shape_dtype_struct"]
+
+
+class ShardMapUnavailable(NotImplementedError):
+    """Raised when no shard_map implementation exists in this jax."""
+
+
+def _resolve():
+    """(callable, style): the best shard_map and which kwarg dialect it
+    speaks — "vma" (modern top-level) or "rep" (experimental)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm, "vma"
+    try:
+        from jax.experimental.shard_map import shard_map as esm
+        return esm, "rep"
+    except ImportError:
+        return None, ""
+
+
+HAS_SHARD_MAP = _resolve()[0] is not None
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=None,
+              axis_names=None, **kw):
+    """``jax.shard_map`` on modern jax, ``jax.experimental.shard_map`` on
+    0.4.x (translating ``check_vma`` to ``check_rep`` and the
+    partial-manual ``axis_names=`` selection to its 0.4.x complement
+    ``auto=``). With ``f=None`` returns a partial, so
+    ``functools.partial(shard_map, mesh=...)`` call sites keep working
+    unchanged."""
+    impl, style = _resolve()
+    if impl is None:
+        raise ShardMapUnavailable(
+            "this jax has neither jax.shard_map nor "
+            "jax.experimental.shard_map; the multi-device paths need one "
+            "of them (install jax >= 0.4.3)")
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+    if check_vma is not None:
+        kwargs["check_vma" if style == "vma" else "check_rep"] = check_vma
+    if axis_names is not None:
+        if style == "vma":
+            kwargs["axis_names"] = axis_names
+        else:
+            kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    if f is None:
+        return functools.partial(impl, **kwargs)
+    return impl(f, **kwargs)
+
+
+def vma_of(x):
+    """The varying-manual-axes set of ``x``'s abstract type — empty on jax
+    without ``jax.typeof`` / VMA types (0.4.x), where every manual-mode
+    value is implicitly varying and there is nothing to propagate."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return frozenset(getattr(typeof(x), "vma", frozenset()))
+
+
+def shape_dtype_struct(shape, dtype, vma=frozenset()):
+    """``jax.ShapeDtypeStruct`` forwarding ``vma=`` only when non-empty —
+    0.4.x has no such kwarg, and :func:`vma_of` returns the empty set
+    there, so the two degrade together."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def pcast(t, axes, to="varying"):
+    """``jax.lax.pcast`` where it exists; identity on pre-VMA jax, whose
+    type system has no varying/invariant distinction to cast between."""
+    impl = getattr(jax.lax, "pcast", None)
+    if impl is None:
+        return t
+    return impl(t, axes, to=to)

@@ -36,6 +36,10 @@ point               fires from
                     ``path="bucket-<P>x<steps>"``) — a raise fails that
                     batch's / that admission's requests with ``error``
                     Results; the engine keeps serving
+``serve.prefill``   the paged scheduler, just before each bounded prefill
+                    CHUNK (ctx carries ``path="bucket-<P>x<steps>"``) — a
+                    raise fails only the rows prefilling in that chunk;
+                    already-decoded rows and queued requests keep serving
 ``serve.decode_step``
                     the row-level scheduler, just before each single-token
                     decode step over a bucket's KV slab (ctx carries

@@ -1,0 +1,23 @@
+"""Seeded violation for donation: ``state`` is read after its buffer was
+donated to the jitted step."""
+
+import functools
+
+import jax
+
+_step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_grads(state, grads):
+    return state - grads
+
+
+def train(state, batch):
+    out = _step(state, batch)
+    return out, state  # read-after-donation: the buffer is deleted
+
+
+def safe_train(state, grads):
+    state = apply_grads(state, grads)  # rebind over the donated ref: safe
+    return state

@@ -1,0 +1,16 @@
+"""Seeded violations for host-sync: device->host pulls inside a hot
+(decode/step-named) function."""
+
+import jax
+import numpy as np
+
+
+def decode_step(tokens, state):
+    val = tokens.item()  # scalar pull: finding
+    host = np.asarray(state)  # device transfer: finding
+    jax.block_until_ready(state)  # pipeline stall: finding
+    return val, host
+
+
+def helper(x):
+    return float(x[0])  # not a hot function: no finding

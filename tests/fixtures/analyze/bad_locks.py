@@ -1,0 +1,27 @@
+"""Seeded violation for lock-discipline: ``ServeEngine.counter`` is
+written from the caller domain (submit) and the worker domain (_run)
+without holding the lock either time."""
+
+import threading
+
+
+class ServeEngine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counter = 0
+        self._state = "new"
+        self._thread = threading.Thread(target=self._run)
+
+    def submit(self, item):
+        self.counter = self.counter + 1  # caller domain, no lock: finding
+        return item
+
+    def close(self):
+        with self._lock:
+            self._state = "closed"  # locked: no finding
+
+    def _run(self):
+        while True:
+            self.counter = 0  # worker domain, no lock: finding
+            with self._lock:
+                self._state = "running"  # locked: no finding

@@ -1,0 +1,25 @@
+"""Seeded violations for recompile: a per-call jit creation, a jit built
+inside a loop body, and a config knob read at trace time."""
+
+import jax
+
+from marlin_tpu.config import get_config
+
+
+def make_program(scale):
+    # closure-jit with no memoization: a fresh trace+compile per call
+    return jax.jit(lambda x: x * scale)
+
+
+def run_all(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(lambda v: v + 1)  # jit-in-loop: one compile per item
+        outs.append(f(x))
+    return outs
+
+
+@jax.jit
+def scaled(x):
+    cfg = get_config()  # traced-knob: baked in at trace time
+    return x * cfg.matmul_precision

@@ -1,0 +1,47 @@
+"""Clean fixture: the idiomatic patterns every files-scope check must
+accept without a finding. The analyze selftest runs all four files-scope
+checks over this file and requires silence."""
+
+import functools
+import threading
+
+import jax
+
+
+@functools.lru_cache(maxsize=8)
+def make_program(dim):
+    # closure-jit is allowed inside a memoized factory: the cache key IS
+    # everything the program closes over
+    return jax.jit(lambda x: x * dim)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update(state, grads):
+    return state - grads
+
+
+def train(state, grads):
+    state = update(state, grads)  # rebinds the donated reference: safe
+    return state
+
+
+def decode_step(state):
+    state = update(state, state)
+    return state
+
+
+class ServeEngine:
+    """Cross-thread writes, every one under the owning lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def submit(self):
+        with self._lock:
+            self._count += 1
+
+    def _run(self):
+        with self._lock:
+            self._count = 0

@@ -1,0 +1,7 @@
+"""Fixture bench scrape acceptance list: ``marlin_mini_missing_total``
+is seeded as wanted-but-never-registered."""
+
+want = (
+    "marlin_mini_ops_total",
+    "marlin_mini_missing_total",
+)

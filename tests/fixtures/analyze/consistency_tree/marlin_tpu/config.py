@@ -1,0 +1,21 @@
+"""Fixture config: seeded default drift (retry_max), a dead knob
+(unused_knob, also undocumented), and a DEPRECATED-exempt field."""
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class MiniConfig:
+    chunk_bytes: int = 64 << 20
+    retry_max: int = 5
+    # DEPRECATED (PR 9): replaced by retry_max; parses, changes nothing
+    legacy_retries: int = 2
+    unused_knob: bool = False
+    page_len: int = 16
+
+
+_config = MiniConfig()
+
+
+def get_config() -> MiniConfig:
+    return _config

@@ -1,0 +1,17 @@
+"""Fixture knob consumer: reads the live knobs, plus one typo'd read
+(``cfg.pagelen``) seeded for the unknown-read direction."""
+
+from .config import get_config
+
+
+def configure(x):
+    cfg = get_config()
+    chunk = cfg.chunk_bytes
+    retries = cfg.retry_max
+    plen = cfg.pagelen  # typo: MiniConfig defines page_len
+    return x, chunk, retries, plen
+
+
+def legacy(x):
+    cfg = get_config()
+    return x, cfg.legacy_retries, cfg.page_len

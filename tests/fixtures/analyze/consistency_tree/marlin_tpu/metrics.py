@@ -1,0 +1,9 @@
+"""Fixture metric registrations: ``marlin_mini_depth`` is seeded as
+registered-but-undocumented."""
+
+
+def register(reg):
+    c = reg.counter("marlin_mini_ops_total", "ops completed")
+    g = reg.gauge("marlin_mini_depth", "queue depth")
+    h = reg.histogram("marlin_mini_latency_seconds", "op latency")
+    return c, g, h

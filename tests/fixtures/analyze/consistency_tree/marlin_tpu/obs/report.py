@@ -1,0 +1,7 @@
+"""Fixture post-mortem vocabulary: ``stale_ev`` is declared but never
+emitted (stale direction); the emitters add ``mystery``/``surprise``
+(unknown directions)."""
+
+KNOWN_KINDS = frozenset({"step", "serve"})
+
+KNOWN_SERVE_EVS = frozenset({"enqueue", "result", "stale_ev"})

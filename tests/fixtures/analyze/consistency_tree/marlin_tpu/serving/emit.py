@@ -1,0 +1,14 @@
+"""Fixture event emitters: one unknown EventLog kind (``mystery``) and
+one unknown serve ev (``surprise``)."""
+
+
+def _emit(log, **fields):
+    log.event("serve", **fields)
+
+
+def body(log):
+    log.event("step", t=1.0)
+    log.event("mystery", t=2.0)  # not in KNOWN_KINDS
+    _emit(log, ev="enqueue")
+    _emit(log, ev="result")
+    _emit(log, ev="surprise")  # not in KNOWN_SERVE_EVS

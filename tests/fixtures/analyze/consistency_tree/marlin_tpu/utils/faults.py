@@ -1,0 +1,8 @@
+"""Fixture fault registry: ``net.flaky`` is seeded as both undocumented
+(no docs/robustness.md row) and untested (no test literal names it)."""
+
+KNOWN_POINTS = frozenset({
+    "ckpt.write",
+    "serve.step",
+    "net.flaky",
+})

@@ -1,0 +1,10 @@
+"""Fixture chaos coverage: names ckpt.write and serve.step (covered) but
+never net.flaky (the seeded test-hygiene violation)."""
+
+
+def test_ckpt_write_survives():
+    assert "ckpt.write"
+
+
+def test_serve_step_survives():
+    assert "serve.step"

@@ -303,6 +303,24 @@ def test_crash_before_manifest_not_committed(tmp_path):
     assert list_generations(d) == []
 
 
+def test_crash_at_payload_write_not_committed(tmp_path):
+    """A failure at the ``ckpt.write`` point (the payload write itself, before
+    any bytes land) leaves the previous generation untouched and loadable,
+    and a clean retry of the failed step commits normally."""
+    d = str(tmp_path)
+    state = {"w": jnp.arange(6.0)}
+    save_checkpoint(state, d, step=1)
+    with faults.injected("ckpt.write", RaiseFault(OSError("disk full"))):
+        with pytest.raises(OSError):
+            save_checkpoint({"w": jnp.zeros(6)}, d, step=2)
+    assert list_generations(d) == [1], "the failed save must not commit"
+    restored, step = load_checkpoint(state, d)
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(6.0))
+    save_checkpoint({"w": jnp.zeros(6)}, d, step=2)  # clean retry
+    assert list_generations(d) == [1, 2]
+
+
 def test_resilient_loop_falls_back_past_corrupt_latest(tmp_path):
     """Acceptance: corrupt latest generation -> ResilientLoop resumes from
     the previous committed one and completes with one metric per step."""

@@ -1,0 +1,20 @@
+"""marlin-analyze: repo-aware static analysis for marlin_tpu.
+
+AST-level companions to the runtime chaos/bench gates: the invariants the
+serving engine, the fault harness, and the docs promise each other are
+checked ahead of time instead of relying on reviewer vigilance. Run as
+
+    python -m tools.analyze                  # whole repo, baseline-gated
+    python -m tools.analyze path/to/file.py  # per-file AST checks only
+    make -C tools analyze-gate               # CI entry (self-tested)
+
+See docs/static_analysis.md for the check catalog, the annotation
+comments (``# analyze: single-writer``, ``# analyze: ignore[<check>]``),
+and the baseline workflow.
+"""
+
+from .core import Finding, Repo, load_baseline, render_json, render_text
+from .checks import CHECKS, get_checks, run_checks
+
+__all__ = ["Finding", "Repo", "CHECKS", "get_checks", "run_checks",
+           "load_baseline", "render_json", "render_text"]

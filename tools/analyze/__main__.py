@@ -1,0 +1,195 @@
+"""CLI: ``python -m tools.analyze [paths...]``.
+
+Default run: every check over the repo, findings diffed against the
+checked-in suppression baseline (``tools/analyze/baseline.json``); exits 1
+when any non-baselined error-severity finding remains — the shape ``make
+analyze-gate`` wires into ``make check``. Stdlib-only: runs on a box that
+cannot import jax.
+
+Modes:
+
+- ``--json`` — machine-readable findings (schema: version/findings/
+  suppressed/stale_baseline_keys; each finding carries check, path, line,
+  message, hint, severity, key).
+- ``--update-baseline --reason '...'`` — regenerate the baseline from the
+  current *new* finding set (existing suppressions keep their reasons;
+  stale keys are pruned). A reason is mandatory: a suppression without a
+  why is a mute button.
+- ``--no-baseline`` — report everything, ignore the suppression file.
+- ``--check NAME`` (repeatable) / ``--list`` — select / enumerate checks.
+- ``--selftest`` — run each check against its seeded-violation fixture
+  under ``tests/fixtures/analyze/`` and assert it fires there and stays
+  silent on the clean fixture; proves the gate can still catch what it
+  claims to catch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .checks import CHECKS, run_checks
+from .core import (Finding, Repo, load_baseline, render_json, render_text,
+                   split_by_baseline)
+
+#: files-scope check -> seeded-violation fixture (repo-scope checks run
+#: over the consistency_tree mini-repo instead)
+FIXTURES = {
+    "lock-discipline": "bad_locks.py",
+    "donation": "bad_donation.py",
+    "recompile": "bad_recompile.py",
+    "host-sync": "bad_hostsync.py",
+}
+FIXTURE_DIR = "tests/fixtures/analyze"
+CLEAN_FIXTURE = "clean.py"
+TREE_FIXTURE = "consistency_tree"
+
+
+def _selftest(root: Path) -> int:
+    """Exit status: 0 when every check fires on its seeded fixture and all
+    stay silent on the clean one."""
+    fdir = root / FIXTURE_DIR
+    failures: list[str] = []
+    ok: list[str] = []
+
+    def expect(label: str, findings: list[Finding], check: str,
+               want: bool) -> None:
+        hits = [f for f in findings if f.check == check]
+        if bool(hits) == want:
+            ok.append(f"{label}: {'fires' if want else 'silent'} "
+                      f"({len(hits)} finding(s))")
+        else:
+            failures.append(
+                f"{label}: expected {'findings' if want else 'silence'}, "
+                f"got {len(hits)}")
+
+    for check, fixture in sorted(FIXTURES.items()):
+        path = fdir / fixture
+        if not path.is_file():
+            failures.append(f"{check}: fixture {path} missing")
+            continue
+        repo = Repo(fdir, explicit_files=[path])
+        expect(f"{check} on {fixture}",
+               run_checks(repo, names=[check]), check, True)
+    clean = fdir / CLEAN_FIXTURE
+    if clean.is_file():
+        repo = Repo(fdir, explicit_files=[clean])
+        for check in FIXTURES:
+            expect(f"{check} on {CLEAN_FIXTURE}",
+                   run_checks(repo, names=[check]), check, False)
+    else:
+        failures.append(f"clean fixture {clean} missing")
+    tree = fdir / TREE_FIXTURE
+    if tree.is_dir():
+        repo = Repo(tree)
+        findings = run_checks(repo, names=["doc-sync", "test-hygiene"])
+        for check in ("doc-sync", "test-hygiene"):
+            expect(f"{check} on {TREE_FIXTURE}/", findings, check, True)
+    else:
+        failures.append(f"fixture tree {tree} missing")
+
+    for line in ok:
+        print(f"  ok: {line}")
+    for line in failures:
+        print(f"  FAIL: {line}")
+    print(f"analyze --selftest: {len(ok)} ok, {len(failures)} failed")
+    return 1 if failures else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="marlin_tpu repo-aware static analysis")
+    ap.add_argument("paths", nargs="*",
+                    help="specific .py files to analyze (default: the "
+                         "whole package + repo-scope checks)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: the tree containing this "
+                         "tool)")
+    ap.add_argument("--check", action="append", dest="checks",
+                    metavar="NAME", help="run only this check (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="list available checks and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="JSON output")
+    ap.add_argument("--baseline", default=None,
+                    help="suppression file (default: "
+                         "tools/analyze/baseline.json under the root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the suppression file")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to suppress the current "
+                         "finding set (requires --reason for new entries)")
+    ap.add_argument("--reason", default="",
+                    help="reason string recorded for new baseline entries")
+    ap.add_argument("--selftest", action="store_true",
+                    help="verify every check fires on its seeded fixture")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, mod in sorted(CHECKS.items()):
+            doc = (mod.__doc__ or "").strip().splitlines()[0]
+            print(f"{name:<16} [{mod.SCOPE:<5}] {doc}")
+        return 0
+
+    root = Path(args.root).resolve() if args.root \
+        else Path(__file__).resolve().parents[2]
+    if args.selftest:
+        return _selftest(root)
+
+    for name in args.checks or ():
+        if name not in CHECKS:
+            print(f"unknown check {name!r} (have: "
+                  f"{', '.join(sorted(CHECKS))})", file=sys.stderr)
+            return 2
+
+    explicit = [Path(p) for p in args.paths] or None
+    if explicit:
+        missing = [p for p in explicit if not p.is_file()]
+        if missing:
+            print(f"no such file: {', '.join(map(str, missing))}",
+                  file=sys.stderr)
+            return 2
+    repo = Repo(root, explicit_files=explicit)
+    # explicit file runs skip the repo-scope checks (they analyze the whole
+    # tree regardless of which file you asked about)
+    scope = "files" if explicit and not args.checks else None
+    findings = run_checks(repo, names=args.checks, scope=scope)
+
+    baseline_path = Path(args.baseline) if args.baseline \
+        else root / "tools" / "analyze" / "baseline.json"
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    new, suppressed, stale = split_by_baseline(findings, baseline)
+
+    if args.update_baseline:
+        if any(f.key not in baseline for f in findings) and not args.reason:
+            print("--update-baseline with new findings requires "
+                  "--reason '...'", file=sys.stderr)
+            return 2
+        entries = [{"key": f.key,
+                    "reason": baseline.get(f.key) or args.reason,
+                    "location": f.location(), "message": f.message}
+                   for f in sorted(findings, key=lambda f: f.key)]
+        payload = {"version": 1,
+                   "note": ("Suppressed findings, each with a reason. "
+                            "Regenerate via `make -C tools analyze "
+                            "BASELINE=update REASON='...'`; never "
+                            "hand-edit keys."),
+                   "entries": entries}
+        baseline_path.parent.mkdir(parents=True, exist_ok=True)
+        baseline_path.write_text(json.dumps(payload, indent=2) + "\n")
+        print(f"baseline updated: {len(entries)} entr(y/ies) -> "
+              f"{baseline_path}")
+        return 0
+
+    if args.as_json:
+        sys.stdout.write(render_json(new, suppressed, stale))
+    else:
+        print(render_text(new, suppressed, stale))
+    return 1 if any(f.severity == "error" for f in new) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,35 @@
+"""Check registry. Each module exposes ``NAME``, ``SCOPE`` ("files" = runs
+over the parsed AST set, "repo" = needs the whole tree: docs, tests,
+bench scripts) and ``run(repo) -> list[Finding]``."""
+
+from __future__ import annotations
+
+from ..core import Finding, Repo
+from . import consistency, donation, hostsync, locks, recompile, testcov
+
+_MODULES = (locks, donation, recompile, hostsync, consistency, testcov)
+
+CHECKS = {m.NAME: m for m in _MODULES}
+
+
+def get_checks(names=None, scope: str | None = None):
+    mods = [CHECKS[n] for n in names] if names else list(_MODULES)
+    if scope is not None:
+        mods = [m for m in mods if m.SCOPE == scope]
+    return mods
+
+
+def run_checks(repo: Repo, names=None, scope: str | None = None
+               ) -> list[Finding]:
+    findings: list[Finding] = []
+    # a file the analyzer cannot parse is itself a finding, never a crash
+    for sf in repo.py_files():
+        if sf.parse_error is not None:
+            findings.append(Finding(
+                check="parse", path=sf.rel,
+                line=sf.parse_error.lineno or 1,
+                message=f"file does not parse: {sf.parse_error.msg}",
+                key=f"parse:{sf.rel}"))
+    for mod in get_checks(names, scope):
+        findings.extend(mod.run(repo))
+    return findings

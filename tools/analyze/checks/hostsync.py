@@ -1,0 +1,148 @@
+"""host-sync: device->host synchronization inside the serving hot path.
+
+A ``.item()``, ``float(x[i])``, ``np.asarray(device_value)`` or
+``block_until_ready()`` inside the engine step / decode / prefill loop
+stalls the Python thread on the accelerator stream — the exact dispatch
+bubble the async-dispatch design (and the PR 11 fused decode kernel) exists
+to avoid. One stray sync per decode step caps tokens/sec at the host
+round-trip rate no matter how fast the kernel is.
+
+Hot functions are selected by name (``_run*``, ``*step*``, ``*decode*``,
+``*prefill*``, ``*worker*``, ``*loop*``, ``*hot*``) or opted in with a
+``# analyze: hot-loop`` annotation on the ``def`` line. Inside them the
+check flags:
+
+- ``<expr>.item()`` / ``<expr>.block_until_ready()`` / ``jax.device_get``
+- ``np.asarray(x)`` / ``np.array(x)`` where ``x`` is not a literal
+  (literals build host arrays; names may be device values)
+- ``float(x)`` / ``int(x)`` of a subscript or call result (scalar pull)
+
+Intentional syncs (batching a transfer at a flush boundary, pulling the
+sampled token because the host must see it) carry
+``# analyze: ignore[host-sync]`` with the reason in prose.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, Repo, dotted
+
+NAME = "host-sync"
+SCOPE = "files"
+
+_HOT_NAME_RE = re.compile(
+    r"(^_run|step|decode|prefill|worker|loop|hot)", re.IGNORECASE)
+
+_SYNC_METHODS = {"item", "block_until_ready"}
+_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+_ASARRAY_LEAVES = {"asarray", "array"}
+
+
+def _is_hot(sf, fn) -> bool:
+    if sf.annotated(fn.lineno, "hot-loop"):
+        return True
+    return bool(_HOT_NAME_RE.search(fn.name))
+
+
+def _literal(node: ast.AST) -> bool:
+    """Literal-ish expressions that can only build host data."""
+    return isinstance(node, (ast.Constant, ast.List, ast.Tuple, ast.Dict,
+                             ast.Set, ast.ListComp, ast.GeneratorExp))
+
+
+def _host_names(fn) -> set[str]:
+    """Names the function rebinds from np.asarray/np.array — after that,
+    subscripting them is host-side indexing, not a device sync (the
+    asarray itself is the sync, and it gets its own finding)."""
+    names: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            d = dotted(node.value.func) or ""
+            if d.split(".")[0] in {"np", "numpy", "onp"} \
+                    and d.split(".")[-1] in _ASARRAY_LEAVES:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _sync_kind(node: ast.Call, host_names: set[str] = frozenset()
+               ) -> str | None:
+    d = dotted(node.func) or ""
+    leaf = d.split(".")[-1]
+    if leaf in _SYNC_METHODS and isinstance(node.func, ast.Attribute):
+        return f".{leaf}()"
+    if d in _SYNC_CALLS:
+        return f"{d}()"
+    root = d.split(".")[0]
+    if root in {"np", "numpy", "onp"} and leaf in _ASARRAY_LEAVES:
+        if node.args and not _literal(node.args[0]):
+            return f"{d}()"
+        return None
+    if d in {"float", "int"} and node.args and isinstance(
+            node.args[0], (ast.Subscript, ast.Call)):
+        arg = node.args[0]
+        # host metadata, not device data: int(x.shape[i]), int(len(...)),
+        # int(getattr(c, "nbytes", 0)), int(time.time()), int(os.environ[k])
+        if isinstance(arg, ast.Subscript):
+            base = dotted(arg.value) or ""
+            if base.split(".")[-1] == "shape" or "environ" in base \
+                    or base.split(".")[0] in host_names:
+                return None
+        if isinstance(arg, ast.Call):
+            leaf = (dotted(arg.func) or "").split(".")[-1]
+            if leaf in {"getattr", "len", "time", "perf_counter",
+                        "monotonic", "get", "getenv"}:
+                return None
+        return f"{d}() of a device value"
+    return None
+
+
+class _HotScan(ast.NodeVisitor):
+    def __init__(self, sf, fn, findings):
+        self.sf, self.fn, self.findings = sf, fn, findings
+        self.host_names = _host_names(fn)
+
+    def visit_FunctionDef(self, node):
+        # nested defs execute on the same hot path when called from it;
+        # keep scanning them — unless the nested def is itself hot, in
+        # which case it gets its own scan (avoid double-reporting)
+        if not _is_hot(self.sf, node):
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        kind = _sync_kind(node, self.host_names)
+        if kind is not None and not self.sf.ignored(node.lineno, NAME):
+            self.findings.append(Finding(
+                check=NAME, path=self.sf.rel, line=node.lineno,
+                message=(f"{kind} inside hot function {self.fn.name}() "
+                         f"forces a device->host sync on the step path"),
+                hint=("keep the value on device (jnp ops / donated "
+                      "updates), batch the transfer at a flush boundary, "
+                      "or annotate `# analyze: ignore[host-sync]` with why "
+                      "this sync is intentional"),
+                key=(f"{NAME}:{self.sf.rel}:{self.fn.name}@{kind}"
+                     f"#{node.lineno}")))
+        self.generic_visit(node)
+
+
+def run(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in repo.py_files():
+        if sf.tree is None:
+            continue
+        # only the runtime packages have a hot path; benches and tests
+        # measure whatever they like
+        if sf.rel.startswith(("tests/", "bench", "tools/")):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _is_hot(sf, node):
+                sc = _HotScan(sf, node, findings)
+                for stmt in node.body:
+                    sc.visit(stmt)
+    return findings

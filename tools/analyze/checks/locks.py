@@ -1,0 +1,223 @@
+"""lock-discipline: cross-thread attribute writes must hold the owning lock.
+
+The serving stack is a handful of classes whose methods run on several
+threads at once: the caller's thread (``submit``/``drain``/``close``/the
+public API), the worker loop (``threading.Thread(target=self._run...)``),
+the supervisor's monitor thread (which calls back into
+``ServeEngine._recover``), the migration mailbox (serviced on the worker,
+posted from peers), and the obs HTTP server (health/flight handlers).
+PR 8's review pass caught a superseded worker mutating the replacement's
+KV pool — exactly the class of bug this check makes structural.
+
+Model: per target class, build the intra-class call graph, seed it with
+the *thread entry points* (public methods = the caller domain, each
+``Thread(target=self.X)`` = a worker domain, plus the repo-aware hints
+below for callback/handler entries), and propagate. A field written
+outside ``__init__`` from methods spanning **two or more domains** must
+have every such write either lexically inside ``with self.<*lock*>:`` or
+carry the ``# analyze: single-writer`` annotation (which documents the
+single-writer claim class-wide for that field).
+
+Target classes: the known concurrent surface (ServeEngine, Router,
+Supervisor, PagedKVPool, ChunkPrefetcher) plus any class that spawns a
+thread on one of its own methods — fixture classes and future subsystems
+are picked up without editing this list.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Repo, dotted
+
+NAME = "lock-discipline"
+SCOPE = "files"
+
+#: always-analyzed classes (the concurrent serving surface)
+KNOWN_CLASSES = {"ServeEngine", "Router", "Supervisor", "PagedKVPool",
+                 "ChunkPrefetcher"}
+
+#: repo-aware extra entry points: methods invoked from a thread the call
+#: graph cannot see (callbacks, HTTP handlers, mailbox services)
+ENTRY_HINTS: dict[str, dict[str, str]] = {
+    # Supervisor._monitor calls engine._recover from the monitor thread
+    "ServeEngine": {"_recover": "supervisor",
+                    # registered as an obs health provider; runs on the
+                    # HTTP server thread
+                    "_health_info": "http"},
+    # Router state is read by the health endpoint too
+    "Router": {"_health_info": "http"},
+}
+
+#: methods treated as construction (happens-before the object escapes)
+CONSTRUCTION = {"__init__", "__post_init__"}
+
+
+def _lockish(expr: ast.AST) -> bool:
+    """True for a with-item that names a lock: ``self._lock``,
+    ``self._restart_lock``, a bare ``lock`` variable, ``self._cv`` ..."""
+    d = dotted(expr)
+    if d is None and isinstance(expr, ast.Call):
+        d = dotted(expr.func)
+    if d is None:
+        return False
+    leaf = d.split(".")[-1].lower()
+    return "lock" in leaf or leaf in {"_mu", "_cv", "_cond", "cond"}
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Per-method facts: self-calls, self-field writes (+lock context),
+    thread targets."""
+
+    def __init__(self):
+        self.calls: set[str] = set()
+        #: (field, line, under_lock)
+        self.writes: list[tuple[str, int, bool]] = []
+        self.thread_targets: set[str] = set()
+        self._lock_depth = 0
+
+    def visit_With(self, node: ast.With):
+        locked = any(_lockish(item.context_expr) for item in node.items)
+        self._lock_depth += 1 if locked else 0
+        self.generic_visit(node)
+        self._lock_depth -= 1 if locked else 0
+
+    def _record_target(self, tgt: ast.AST, line: int):
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            self.writes.append((tgt.attr, line, self._lock_depth > 0))
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._record_target(el, line)
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            self._record_target(tgt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._record_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._record_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        d = dotted(node.func)
+        if d is not None and d.startswith("self."):
+            parts = d.split(".")
+            if len(parts) == 2:
+                self.calls.add(parts[1])
+        if d is not None and d.split(".")[-1] == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    t = dotted(kw.value)
+                    if t is not None and t.startswith("self."):
+                        self.thread_targets.add(t.split(".")[1])
+        self.generic_visit(node)
+
+    # nested defs run on the same thread as their caller; scan them too
+    # (closures registered elsewhere are covered by ENTRY_HINTS)
+
+
+def _spawns_thread(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is not None and d.split(".")[-1] == "Thread":
+                for kw in node.keywords:
+                    t = dotted(kw.value) or ""
+                    if kw.arg == "target" and t.startswith("self."):
+                        return True
+    return False
+
+
+def _analyze_class(sf, cls: ast.ClassDef) -> list[Finding]:
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    scans: dict[str, _MethodScan] = {}
+    for name, fn in methods.items():
+        sc = _MethodScan()
+        for stmt in fn.body:
+            sc.visit(stmt)
+        scans[name] = sc
+
+    # entry -> domain label
+    entries: dict[str, str] = {}
+    for name in methods:
+        if not name.startswith("_") or name in {"__enter__", "__exit__"}:
+            entries[name] = "caller"
+    for name, sc in scans.items():
+        for tgt in sc.thread_targets:
+            if tgt in methods:
+                entries[tgt] = f"worker:{tgt}"
+    for m, dom in ENTRY_HINTS.get(cls.name, {}).items():
+        if m in methods:
+            entries[m] = dom
+    for m in CONSTRUCTION:
+        entries.pop(m, None)
+
+    # propagate domains over the self-call graph
+    domains: dict[str, set[str]] = {n: set() for n in methods}
+    for entry, dom in entries.items():
+        stack, seen = [entry], set()
+        while stack:
+            m = stack.pop()
+            if m in seen or m not in methods:
+                continue
+            seen.add(m)
+            domains[m].add(dom)
+            stack.extend(scans[m].calls)
+
+    # fields declared single-writer anywhere in the class body
+    single_writer: set[str] = set()
+    for name, sc in scans.items():
+        for field, line, _ in sc.writes:
+            if sf.annotated(line, "single-writer"):
+                single_writer.add(field)
+
+    # collect write sites per field (construction excluded)
+    by_field: dict[str, list[tuple[str, int, bool]]] = {}
+    for name, sc in scans.items():
+        if name in CONSTRUCTION:
+            continue
+        for field, line, locked in sc.writes:
+            by_field.setdefault(field, []).append((name, line, locked))
+
+    findings = []
+    for field, sites in sorted(by_field.items()):
+        doms = set()
+        for meth, _, _ in sites:
+            doms |= domains.get(meth, set())
+        if len(doms) < 2 or field in single_writer:
+            continue
+        for meth, line, locked in sites:
+            if locked or sf.ignored(line, NAME):
+                continue
+            findings.append(Finding(
+                check=NAME, path=sf.rel, line=line,
+                message=(f"{cls.name}.{field} is written from thread "
+                         f"domains {{{', '.join(sorted(doms))}}} but this "
+                         f"write in {meth}() holds no lock"),
+                hint=("wrap the write in `with self._lock:` (the owning "
+                      "lock), or annotate the field's write with "
+                      "`# analyze: single-writer` and say why it is "
+                      "single-writer by design"),
+                key=f"{NAME}:{sf.rel}:{cls.name}.{field}@{meth}"))
+    return findings
+
+
+def run(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in repo.py_files():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if node.name in KNOWN_CLASSES or _spawns_thread(node):
+                findings.extend(_analyze_class(sf, node))
+    return findings

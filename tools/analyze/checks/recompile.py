@@ -1,0 +1,173 @@
+"""recompile: jit programs created where they cannot be cached.
+
+The PR 4 bug class: ``streamed_matmul`` built ``jax.jit(...)`` inside the
+per-call path, so every invocation traced and compiled from scratch —
+correct results, 100x the latency, invisible without the compile-count
+fixture. Statically visible shapes of the same hazard:
+
+1. **closure-jit** — ``jax.jit`` created inside a function (the returned
+   program's cache dies with the frame, and closures over per-call Python
+   values silently specialize). Allowed when the enclosing function is
+   itself memoized (``functools.lru_cache`` / ``functools.cache`` — the
+   repo idiom for mesh-keyed program factories) at any enclosing level.
+2. **jit-in-loop** — ``jax.jit`` called inside a ``for``/``while`` body:
+   a fresh program per iteration, never cacheable.
+3. **traced-knob** — a ``get_config()`` read inside a jit-decorated
+   function body: the knob is baked in at trace time, so flipping the
+   config silently does nothing until an unrelated retrace (these should
+   be traced array arguments, or read by the caller and passed in).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Repo, dotted
+
+NAME = "recompile"
+SCOPE = "files"
+
+_CACHE_DECOS = {"lru_cache", "cache", "cached_property"}
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    d = dotted(node.func) or ""
+    if d in {"jax.jit", "jit", "pjit", "jax.pjit"}:
+        return True
+    # functools.partial(jax.jit, ...) builds a jit when later applied; the
+    # partial itself is the creation site
+    if d.split(".")[-1] == "partial" and node.args:
+        return (dotted(node.args[0]) or "").endswith("jit")
+    return False
+
+
+def _is_cached_fn(fn: ast.AST) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        d = dotted(dec if not isinstance(dec, ast.Call) else dec.func) or ""
+        if d.split(".")[-1] in _CACHE_DECOS:
+            return True
+    return False
+
+
+def _is_jitted_fn(fn) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call) and _is_jit_call(dec):
+            return True
+        d = dotted(dec) or ""
+        if d in {"jax.jit", "jit"}:
+            return True
+    return False
+
+
+def _scan(sf, node, fn_stack, loop_depth, findings, in_decorator=False):
+    """Recursive walk tracking the enclosing function stack and loop depth."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        # a bare-@jax.jit decoration on a def nested inside a function is a
+        # jit creation with no Call node — same closure-jit hazard
+        enclosing_fns = [f for f in fn_stack
+                         if isinstance(f, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))]
+        if (enclosing_fns and _is_jitted_fn(node)
+                and not any(_is_cached_fn(f) for f in enclosing_fns)
+                and not sf.ignored(node.lineno, NAME)):
+            findings.append(Finding(
+                check=NAME, path=sf.rel, line=node.lineno,
+                message=(f"jitted {node.name}() defined inside "
+                         f"{enclosing_fns[-1].name}() — the compile cache "
+                         f"dies with the call frame and closed-over Python "
+                         f"values re-specialize it per call (the PR 4 "
+                         f"streamed_matmul bug class)"),
+                hint="move the jit to module scope, or memoize the factory "
+                     "with @functools.lru_cache keyed on everything the "
+                     "program closes over",
+                key=f"{NAME}:{sf.rel}:{enclosing_fns[-1].name}"
+                    f".{node.name}@closure"))
+        # decorators evaluate at def time in the OUTER scope, and a jitted
+        # decoration is reported by the def-based branch above — visit them
+        # with the outer stack and the Call-based jit check muted
+        for dec in getattr(node, "decorator_list", ()):
+            _scan(sf, dec, fn_stack, loop_depth, findings, in_decorator=True)
+        fn_stack = fn_stack + [node]
+        loop_depth = 0  # a loop outside a def does not loop the def body
+        for name, field in ast.iter_fields(node):
+            if name == "decorator_list":
+                continue
+            children = field if isinstance(field, list) else [field]
+            for child in children:
+                if isinstance(child, ast.AST):
+                    _scan(sf, child, fn_stack, loop_depth, findings)
+        return
+    in_loop = isinstance(node, (ast.For, ast.While, ast.AsyncFor))
+    if in_loop:
+        loop_depth += 1
+
+    if isinstance(node, ast.Call) and _is_jit_call(node) and not in_decorator:
+        line = node.lineno
+        if not sf.ignored(line, NAME):
+            if loop_depth > 0:
+                findings.append(Finding(
+                    check=NAME, path=sf.rel, line=line,
+                    message="jax.jit program created inside a loop body — "
+                            "one fresh trace+compile per iteration",
+                    hint="hoist the jit to module scope (or a memoized "
+                         "factory) and call the cached program in the loop",
+                    key=f"{NAME}:{sf.rel}:loop@{line}"))
+            else:
+                enclosing = [f for f in fn_stack
+                             if isinstance(f, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef))]
+                # decorator expressions evaluate at def time in the OUTER
+                # scope: a jit decorating a module-scope def is fine even
+                # though the Call node sits on the FunctionDef
+                deco_of = enclosing[-1] if enclosing else None
+                if (deco_of is not None and node in getattr(
+                        deco_of, "decorator_list", ())):
+                    enclosing = enclosing[:-1]
+                if enclosing and not any(_is_cached_fn(f)
+                                         for f in enclosing):
+                    fname = enclosing[-1].name
+                    findings.append(Finding(
+                        check=NAME, path=sf.rel, line=line,
+                        message=(f"jax.jit program created inside "
+                                 f"{fname}() — the compile cache dies "
+                                 f"with the call frame and closed-over "
+                                 f"Python values re-specialize it per "
+                                 f"call (the PR 4 streamed_matmul bug "
+                                 f"class)"),
+                        hint=("move the jit to module scope, or memoize "
+                              "the factory with @functools.lru_cache "
+                              "keyed on everything the program closes "
+                              "over"),
+                        key=f"{NAME}:{sf.rel}:{fname}@closure"))
+
+    # traced-knob: config read inside a jitted function body
+    if (isinstance(node, ast.Call)
+            and (dotted(node.func) or "").split(".")[-1] == "get_config"
+            and any(_is_jitted_fn(f) for f in fn_stack)
+            and not sf.ignored(node.lineno, NAME)):
+        jf = [f for f in fn_stack if _is_jitted_fn(f)][-1]
+        findings.append(Finding(
+            check=NAME, path=sf.rel, line=node.lineno,
+            message=(f"get_config() read inside jitted {jf.name}() — the "
+                     f"knob's value is baked in at trace time; changing "
+                     f"the config later silently does nothing"),
+            hint="read the knob in the caller and pass it as a traced "
+                 "array argument (or a static_argnames entry if it must "
+                 "re-specialize)",
+            key=f"{NAME}:{sf.rel}:{jf.name}@traced-knob"))
+
+    for child in ast.iter_child_nodes(node):
+        _scan(sf, child, fn_stack, loop_depth, findings)
+
+
+def run(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in repo.py_files():
+        if sf.tree is None:
+            continue
+        _scan(sf, sf.tree, [], 0, findings)
+    return findings

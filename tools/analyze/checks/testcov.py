@@ -1,0 +1,89 @@
+"""test-hygiene: every registered fault point is exercised by a test.
+
+``utils/faults.py`` rejects unknown point names at ``inject()`` time, so a
+typo cannot silently never fire — but nothing stops a point from being
+*registered* and then never exercised. A fault point with zero chaos tests
+is a claim ("this failure mode is survivable") nobody has checked.
+
+The check parses ``KNOWN_POINTS`` out of the faults module's AST and
+requires each point name to appear as a string literal somewhere under
+``tests/`` (fixtures excluded). Appearance is deliberately loose — an
+``inject("serve.step", ...)``, a parametrize list, or a helper table all
+count; the point is to force *a* test to name the point, not to prescribe
+how it is driven.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import EXCLUDE_PARTS, Finding, Repo
+
+NAME = "test-hygiene"
+SCOPE = "repo"
+
+FAULTS_REL = "marlin_tpu/utils/faults.py"
+TESTS_REL = "tests"
+
+
+def known_points(repo: Repo) -> tuple[list[str], int]:
+    """(points, lineno) parsed from the KNOWN_POINTS literal; ([], 0) when
+    the faults module is absent (fixture trees)."""
+    sf = repo.file(FAULTS_REL)
+    if sf is None or sf.tree is None:
+        return [], 0
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "KNOWN_POINTS"
+                   for t in node.targets):
+            continue
+        val = node.value
+        if isinstance(val, ast.Call) and val.args:  # frozenset({...})
+            val = val.args[0]
+        if isinstance(val, (ast.Set, ast.List, ast.Tuple)):
+            pts = [el.value for el in val.elts
+                   if isinstance(el, ast.Constant)
+                   and isinstance(el.value, str)]
+            return sorted(pts), node.lineno
+    return [], 0
+
+
+def _test_literals(repo: Repo) -> set[str]:
+    """Every string constant in every test file (AST-level, so commented-out
+    mentions don't count as coverage)."""
+    lits: set[str] = set()
+    base = repo.root / TESTS_REL
+    if not base.is_dir():
+        return lits
+    for p in sorted(base.rglob("*.py")):
+        if EXCLUDE_PARTS.intersection(p.relative_to(repo.root).parts):
+            continue
+        sf = repo.file(str(p.relative_to(repo.root)))
+        if sf is None or sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                lits.add(node.value)
+    return lits
+
+
+def run(repo: Repo) -> list[Finding]:
+    points, lineno = known_points(repo)
+    if not points:
+        return []
+    lits = _test_literals(repo)
+    findings = []
+    for pt in points:
+        if pt in lits:
+            continue
+        findings.append(Finding(
+            check=NAME, path=FAULTS_REL, line=lineno,
+            message=(f"fault point {pt!r} is registered in KNOWN_POINTS "
+                     f"but no test under {TESTS_REL}/ ever names it — the "
+                     f"failure mode it models is untested"),
+            hint=(f"add a chaos test that inject()s a fault at {pt!r} and "
+                  f"asserts the system survives (see tests/test_faults.py "
+                  f"for the idiom)"),
+            key=f"{NAME}:{FAULTS_REL}:{pt}@untested"))
+    return findings

@@ -1,0 +1,292 @@
+"""Shared driver for the marlin-analyze checks.
+
+One :class:`Repo` is built per run (parsed files are cached on it), every
+check receives it and returns :class:`Finding`\\ s, and the CLI diffs the
+result against the checked-in suppression baseline. Stdlib-only by design:
+the analyzer must run on a box that cannot even import jax.
+
+Annotation comments (anywhere in a source line; the bare-comment form
+applies to the next code line):
+
+- ``# analyze: ignore[<check>]`` — suppress that check's findings on the
+  annotated line (``ignore`` alone suppresses every check). Put the *why*
+  in the rest of the comment; the annotation is the mechanism, the prose
+  is the contract.
+- ``# analyze: single-writer`` — on a ``self.<field> = ...`` line: declare
+  the field single-writer by design, class-wide (lock-discipline).
+- ``# analyze: hot-loop`` — on a ``def`` line: opt the function into the
+  host-sync hot-path set even though its name doesn't match the patterns.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Iterator
+
+__all__ = ["Finding", "SourceFile", "Repo", "load_baseline", "save_baseline",
+           "split_by_baseline", "render_text", "render_json"]
+
+_ANNOT_RE = re.compile(r"#\s*analyze:\s*([a-z-]+)(?:\[([^\]]*)\])?")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer result, with enough context to fix it.
+
+    ``key`` is the stable identity used by the baseline file — built from
+    symbol names, never line numbers, so unrelated edits don't churn the
+    baseline.
+    """
+
+    check: str
+    path: str          # repo-root-relative
+    line: int
+    message: str
+    hint: str = ""
+    severity: str = "error"   # "error" gates; "warn" reports only
+    key: str = ""
+
+    def __post_init__(self):
+        if not self.key:
+            self.key = f"{self.check}:{self.path}:{self.line}"
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SourceFile:
+    """One parsed Python file: source text, AST, and ``# analyze:``
+    annotations resolved to the code line they govern."""
+
+    def __init__(self, path: Path, rel: str):
+        self.abspath = path
+        self.rel = rel
+        self.text = path.read_text(encoding="utf-8", errors="replace")
+        self.lines = self.text.splitlines()
+        self.parse_error: SyntaxError | None = None
+        try:
+            self.tree: ast.Module | None = ast.parse(self.text)
+        except SyntaxError as e:  # surfaced as a finding, never a crash
+            self.tree = None
+            self.parse_error = e
+        #: line -> set of (name, arg) annotation tuples governing that line
+        self.annotations: dict[int, set[tuple[str, str]]] = {}
+        self._scan_annotations()
+
+    def _scan_annotations(self) -> None:
+        pending: set[tuple[str, str]] = set()
+        for i, raw in enumerate(self.lines, start=1):
+            found = {(m.group(1), (m.group(2) or "").strip())
+                     for m in _ANNOT_RE.finditer(raw)}
+            stripped = raw.strip()
+            if stripped.startswith("#"):
+                # standalone comment: annotation carries to the next code line
+                pending |= found
+                continue
+            if stripped:
+                here = found | pending
+                if here:
+                    self.annotations[i] = here
+                pending = set()
+            # blank lines keep the pending set alive
+
+    def annotated(self, line: int, name: str, arg: str | None = None) -> bool:
+        for n, a in self.annotations.get(line, ()):
+            if n != name:
+                continue
+            if arg is None or not a or arg in {s.strip() for s in a.split(",")}:
+                return True
+        return False
+
+    def ignored(self, line: int, check: str) -> bool:
+        """True when the line carries ``# analyze: ignore`` for ``check``
+        (or the blanket form)."""
+        for n, a in self.annotations.get(line, ()):
+            if n == "ignore" and (not a or check in
+                                  {s.strip() for s in a.split(",")}):
+                return True
+        return False
+
+
+#: directories never scanned (seeded-violation fixtures live under
+#: tests/fixtures/analyze and MUST NOT leak into the repo gate)
+EXCLUDE_PARTS = {"fixtures", "__pycache__", ".git", "node_modules"}
+
+#: default scan set for the per-file AST checks
+DEFAULT_PY_ROOTS = ("marlin_tpu",)
+
+
+class Repo:
+    """The analyzed tree. ``py_files()`` yields parsed sources under the
+    AST-check roots; ``file()``/``text()`` fetch arbitrary repo-relative
+    paths (docs, bench scripts) for the repo-scope checks. Everything is
+    cached per instance, so N checks parse each file once."""
+
+    def __init__(self, root: str | Path, py_roots=DEFAULT_PY_ROOTS,
+                 explicit_files: list[Path] | None = None):
+        self.root = Path(root).resolve()
+        self.py_roots = tuple(py_roots)
+        self.explicit_files = [Path(p).resolve() for p in explicit_files or []]
+        self._cache: dict[str, SourceFile | None] = {}
+
+    def _rel(self, p: Path) -> str:
+        try:
+            return str(p.resolve().relative_to(self.root))
+        except ValueError:
+            return str(p)
+
+    def _load(self, p: Path) -> SourceFile | None:
+        rel = self._rel(p)
+        if rel not in self._cache:
+            self._cache[rel] = (SourceFile(p, rel) if p.is_file() else None)
+        return self._cache[rel]
+
+    def file(self, rel: str) -> SourceFile | None:
+        return self._load(self.root / rel)
+
+    def text(self, rel: str) -> str | None:
+        p = self.root / rel
+        return p.read_text(encoding="utf-8", errors="replace") \
+            if p.is_file() else None
+
+    def exists(self, rel: str) -> bool:
+        return (self.root / rel).exists()
+
+    def _iter_root(self, sub: str) -> Iterator[Path]:
+        base = self.root / sub
+        if base.is_file():
+            yield base
+            return
+        if not base.is_dir():
+            return
+        for p in sorted(base.rglob("*.py")):
+            # exclusion is root-relative, so a Repo rooted *inside* a
+            # fixture tree still scans its own files
+            if EXCLUDE_PARTS.intersection(p.relative_to(self.root).parts):
+                continue
+            yield p
+
+    def py_files(self, roots=None) -> Iterator[SourceFile]:
+        """Parsed sources for the AST checks: the explicit file list when
+        one was given on the CLI, else everything under ``roots``."""
+        if self.explicit_files:
+            for p in self.explicit_files:
+                sf = self._load(p)
+                if sf is not None:
+                    yield sf
+            return
+        for sub in roots or self.py_roots:
+            for p in self._iter_root(sub):
+                sf = self._load(p)
+                if sf is not None:
+                    yield sf
+
+
+# --------------------------------------------------------------- baseline
+
+def load_baseline(path: str | Path) -> dict[str, str]:
+    """``key -> reason`` from the suppression file; {} when absent."""
+    p = Path(path)
+    if not p.is_file():
+        return {}
+    data = json.loads(p.read_text())
+    out = {}
+    for e in data.get("entries", []):
+        out[e["key"]] = e.get("reason", "")
+    return out
+
+
+def save_baseline(path: str | Path, findings: list[Finding],
+                  reason: str) -> None:
+    """Regenerate the suppression file from the current finding set. Every
+    entry carries a reason string — a baseline without a why is a mute
+    button, not a decision."""
+    entries = [{"key": f.key, "reason": reason,
+                "location": f.location(), "message": f.message}
+               for f in sorted(findings, key=lambda f: f.key)]
+    payload = {"version": 1,
+               "note": ("Suppressed pre-existing findings. Regenerate "
+                        "deliberately via `make -C tools analyze "
+                        "BASELINE=update REASON='...'`; never hand-edit "
+                        "keys."),
+               "entries": entries}
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def split_by_baseline(findings: list[Finding], baseline: dict[str, str]):
+    """(new, suppressed, stale_keys): findings not in the baseline, findings
+    the baseline covers, and baseline keys that no longer match anything
+    (candidates for pruning)."""
+    new, suppressed = [], []
+    seen = set()
+    for f in findings:
+        if f.key in baseline:
+            suppressed.append(f)
+            seen.add(f.key)
+        else:
+            new.append(f)
+    stale = sorted(set(baseline) - seen)
+    return new, suppressed, stale
+
+
+# --------------------------------------------------------------- rendering
+
+def render_text(findings: list[Finding], suppressed: list[Finding] = (),
+                stale: list[str] = ()) -> str:
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.check)):
+        out.append(f"{f.location()}: [{f.check}] {f.severity}: {f.message}")
+        if f.hint:
+            out.append(f"    hint: {f.hint}")
+    if suppressed:
+        out.append(f"({len(suppressed)} pre-existing finding(s) suppressed "
+                   f"by baseline)")
+    for k in stale:
+        out.append(f"stale baseline entry (no matching finding): {k}")
+    errors = sum(1 for f in findings if f.severity == "error")
+    warns = len(findings) - errors
+    out.append(f"analyze: {errors} error(s), {warns} warning(s)"
+               + (" — clean" if not findings else ""))
+    return "\n".join(out)
+
+
+def render_json(findings: list[Finding], suppressed: list[Finding] = (),
+                stale: list[str] = ()) -> str:
+    return json.dumps({
+        "version": 1,
+        "findings": [f.to_dict() for f in findings],
+        "suppressed": [f.to_dict() for f in suppressed],
+        "stale_baseline_keys": list(stale),
+    }, indent=2) + "\n"
+
+
+# ------------------------------------------------------------ AST helpers
+
+def dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    """Dotted name of the called object, else None."""
+    return dotted(call.func)
+
+
+def str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -22,6 +22,9 @@ serve-gate:
 ooc-gate:
 	$(MAKE) -C tools ooc-gate
 
+obs-gate:
+	$(MAKE) -C tools obs-gate
+
 # repo-aware static analysis (tools/analyze; docs/static_analysis.md):
 #   make analyze / make analyze-gate
 #   make analyze BASELINE=update REASON='why'
@@ -45,5 +48,5 @@ tier1:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: check native serve-gate ooc-gate analyze analyze-gate chunkstore \
-	tier1
+.PHONY: check native serve-gate ooc-gate obs-gate analyze analyze-gate \
+	chunkstore tier1

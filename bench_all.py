@@ -31,6 +31,7 @@ the BASELINE config list:
        tokens/s per offered rate (MARLIN_BENCH_SERVE_* env knobs scale it)
 """
 
+import contextlib
 import json
 import os
 import subprocess
@@ -1023,6 +1024,132 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
            f"{events_path} (analyze: python -m marlin_tpu.obs.report)")
 
 
+def config_serve_slo(d_model=64, heads=4, layers=2, vocab=256):
+    """SLO-engine acceptance leg (docs/observability.md "Serving SLOs"):
+    the same open-loop serve run twice — leg A with `serve_slo` objectives
+    configured (generous targets, so the engine evaluates but never
+    breaches) and leg B plain — and records (a) the `marlin_slo_*`
+    families carried by a live /metrics scrape plus the `/debug/slo`
+    payload DURING leg A's serve, and (b) passivity: an
+    evaluating-but-quiet SLO engine must cost <= 2% tok/s vs the plain
+    engine (the A/B lands as `serve_slo_passivity`; tools/Makefile's
+    obs-gate reads both through bench_compare --only serve_).
+
+    MARLIN_BENCH_SERVE_SLO_N (requests per leg, default 48) and
+    MARLIN_BENCH_SERVE_SLO_RATE (req/s, default 32) size the legs."""
+    import urllib.request
+
+    import jax  # noqa: F401  (backend init before threads)
+
+    import marlin_tpu as mt
+    from marlin_tpu import obs
+    from marlin_tpu.models import TransformerLM
+    from marlin_tpu.serving import Request, ServeEngine
+
+    n_req = int(os.environ.get("MARLIN_BENCH_SERVE_SLO_N", 48))
+    rate = float(os.environ.get("MARLIN_BENCH_SERVE_SLO_RATE", 32))
+    buckets = ((64, 32),)
+    params = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
+                           layers=layers, seed=0).init_params()
+    rng = np.random.default_rng(0)
+    # generous targets: the leg proves evaluation cost + exposition, not
+    # breach handling (tests/test_slo.py owns the breach state machine)
+    slo_cfg = (
+        {"name": "ttft", "metric": "p95:marlin_serve_ttft_seconds",
+         "target": 60.0, "window_s": 600.0},
+        {"name": "avail",
+         "metric": "ratio:marlin_serve_requests_total{status=ok}"
+                   "/marlin_serve_requests_total",
+         "target": 0.5, "window_s": 600.0},
+    )
+
+    srv = obs.MetricsServer(port=int(os.environ.get("MARLIN_BENCH_OBS_PORT",
+                                                    "0")))
+    obs_port = srv.start()
+    scrape, slo_json = "", ""
+
+    def run_leg(with_slo):
+        nonlocal scrape, slo_json
+        ctx = (mt.config_context(serve_slo=slo_cfg,
+                                 serve_slo_eval_interval_s=0.25,
+                                 serve_ts_bucket_s=1.0)
+               if with_slo else contextlib.nullcontext())
+        with ctx:
+            eng = ServeEngine(params, heads, buckets=buckets, max_batch=8,
+                              max_wait_ms=5.0, queue_depth=4 * n_req)
+        try:
+            eng.warmup()
+            gaps = rng.exponential(1.0 / rate, n_req)
+            handles, t0 = [], time.perf_counter()
+            for i in range(n_req):
+                if i:
+                    time.sleep(gaps[i - 1])
+                plen = int(rng.integers(8, 48))
+                handles.append(eng.submit(Request(
+                    prompt=rng.integers(0, vocab, plen).astype(np.int32),
+                    steps=int(rng.integers(4, 17)))))
+            scraper = None
+            if with_slo:
+                def _scrape_live():  # off-thread: never inflates the span
+                    nonlocal scrape, slo_json
+                    try:
+                        scrape = urllib.request.urlopen(
+                            f"http://127.0.0.1:{obs_port}/metrics",
+                            timeout=10).read().decode()
+                        slo_json = urllib.request.urlopen(
+                            f"http://127.0.0.1:{obs_port}/debug/slo",
+                            timeout=10).read().decode()
+                    except Exception:
+                        pass  # the record shows 0/5 families
+                scraper = threading.Thread(target=_scrape_live, daemon=True)
+                scraper.start()
+            eng.drain()
+            span = time.perf_counter() - t0
+        finally:
+            eng.close()
+        if scraper is not None:
+            scraper.join(timeout=15.0)
+        results = [h.result(timeout=0) for h in handles]
+        toks = sum(r.tokens.size - len(h.request.prompt)
+                   for h, r in zip(handles, results) if r.ok)
+        return toks / span, sum(r.ok for r in results)
+
+    try:
+        # throwaway warm leg: the first engine of the process pays
+        # first-render/threadpool costs that would land entirely on
+        # whichever A/B leg runs first and masquerade as SLO overhead
+        run_leg(False)
+        # SLO leg next so the scrape catches it live; plain leg last
+        tok_slo, ok_slo = run_leg(True)
+        tok_plain, ok_plain = run_leg(False)
+    finally:
+        srv.close()
+
+    want = ("marlin_slo_compliance", "marlin_slo_budget_remaining",
+            "marlin_slo_burn_rate", "marlin_slo_breached",
+            "marlin_slo_shed_total")
+    got = [n for n in want if f"# TYPE {n} " in scrape]
+    payload = {}
+    try:
+        payload = json.loads(slo_json)
+    except Exception:
+        pass
+    scopes = payload.get("scopes") or []
+    slo_names = sorted({o.get("slo") for s in scopes
+                        for o in s.get("objectives", ())})
+    record("serve_slo", float(len(got)), "families",
+           f"live /metrics scrape during an SLO-evaluating serve carried "
+           f"{len(got)}/{len(want)} marlin_slo_* series ({', '.join(got)}); "
+           f"/debug/slo returned {len(scopes)} scope(s) with objectives "
+           f"{slo_names}; {ok_slo}/{n_req} ok")
+    delta = (tok_plain - tok_slo) / tok_plain if tok_plain > 0 else 0.0
+    record("serve_slo_passivity", tok_slo, "tok/s",
+           f"SLO leg {tok_slo:.1f} tok/s vs plain {tok_plain:.1f} tok/s "
+           f"({delta:+.1%} cost; acceptance bar <= 2%); {ok_plain}/{n_req} "
+           f"ok plain leg", extra={"plain_tok_s": round(tok_plain, 2),
+                                   "delta_frac": round(delta, 4)})
+
+
 def config_svd(m=1_000_000, n=512, k=8):
     """Top-k SVD of a tall-skinny matrix via the distributed Gramian +
     matrix-free Lanczos path (the reference's dist-eigs ARPACK mode,
@@ -1153,6 +1280,7 @@ def main():
         "decode": config_decode,
         "moe": config_moe,
         "serve": config_serve,
+        "serve_slo": config_serve_slo,
     }
     for k in which:
         log(f"=== config {k}")

@@ -197,6 +197,43 @@ class MarlinConfig:
     # after a rolling restart (hottest-first; best-effort — a failed warm
     # never fails the restart). 0 disables cache warming.
     serve_cache_warm_prefixes: int = 32
+    # --- serving SLOs (obs/slo.py, obs/timeseries.py) ------------------------
+    # Declarative service-level objectives, evaluated live per engine (and
+    # merged fleet-wide by the router): a tuple of dicts
+    # {"name", "metric", "target", "window_s"[, "op", "budget"]}, e.g.
+    # ({"name": "ttft", "metric": "p99:marlin_serve_ttft_seconds",
+    #   "target": 0.5, "window_s": 300},) — see obs/slo.py for the metric
+    # grammar (pNN/mean/ratio/rate/gauge over time-series names). Empty
+    # (the default) disables the SLO engine and the time-series store
+    # entirely: zero hot-path cost.
+    serve_slo: tuple = ()
+    # Seconds between SLO evaluations — the rate limit on the tick the
+    # serving worker loop and the /debug/slo endpoint drive (no dedicated
+    # evaluation thread exists).
+    serve_slo_eval_interval_s: float = 5.0
+    # The reactive burn window: error rates over this trailing window feed
+    # the fast burn rate that trips breaches (each objective's own
+    # window_s smooths the headline compliance number).
+    serve_slo_fast_window_s: float = 60.0
+    # Fast-window burn-rate threshold that flips an objective to breached
+    # (burn 1.0 = consuming the error budget exactly over the window).
+    serve_slo_burn_fast: float = 10.0
+    # Hysteresis: consecutive evaluations with the fast burn under half
+    # the threshold before a breached objective clears (and admission
+    # shedding releases).
+    serve_slo_hysteresis: int = 2
+    # Breached objectives drive graceful degradation: admission sheds the
+    # lowest-priority / longest-deadline work (clean reject-with-reason,
+    # never a drop) while the breach persists. False = observe-only.
+    serve_slo_shed: bool = True
+    # Deadline slack (seconds to deadline at submission) under which a
+    # request counts as urgent and earns one tier of shed protection.
+    serve_slo_shed_slack_s: float = 2.0
+    # Time-series store geometry: maximum trailing window any SLO/query
+    # can span, and the ring's bucket alignment (memory is bounded by
+    # window/bucket buckets per series).
+    serve_ts_window_s: float = 600.0
+    serve_ts_bucket_s: float = 5.0
     # --- autotune persistence (parallel/autotune.py) -------------------------
     # Where the empirical multiply-strategy winners persist across processes.
     # None = ~/.cache/marlin_tpu/autotune.json; "" disables the disk layer

@@ -18,6 +18,16 @@ ServeMetrics counters, StageTimes, a test-only compile tally):
   trace in the JSONL.
 - :mod:`~marlin_tpu.obs.report` — the post-hoc analyzer
   (``python -m marlin_tpu.obs.report events.jsonl``).
+- :mod:`~marlin_tpu.obs.timeseries` — bounded in-process windowed store
+  (ring of aligned time buckets per series) fed from the registry by a
+  render-time collector; rate/delta/percentile over trailing windows.
+- :mod:`~marlin_tpu.obs.slo` — declarative serving SLOs (``serve_slo``
+  config) evaluated over the time-series store: multi-window error-budget
+  burn rates with hysteresis, ``marlin_slo_*`` gauges, breach hooks that
+  drive graceful degradation, ``GET /debug/slo``.
+- :mod:`~marlin_tpu.obs.console` — live terminal ops console
+  (``python -m marlin_tpu.obs.console``) polling ``/metrics`` +
+  ``/debug/slo``.
 - :mod:`~marlin_tpu.obs.perf` — performance introspection: per-program
   roofline accounting (XLA cost models joined with measured wall times →
   ``marlin_program_*`` series and the analyzer's utilization table), the
@@ -39,7 +49,10 @@ from .metrics import (  # noqa: F401
 from .exposition import MetricsServer, start_from_config  # noqa: F401
 from . import collectors  # noqa: F401  (imports utils.tracing lazily)
 from . import perf  # noqa: F401  (imports jax lazily)
+from .timeseries import TimeSeriesStore, install_collector  # noqa: F401
+from .slo import SloEngine, fleet_merge, objectives_from_config  # noqa: F401
 
 __all__ = ["trace", "collectors", "perf", "Counter", "Gauge", "Histogram",
            "MetricsRegistry", "get_registry", "percentile", "MetricsServer",
-           "start_from_config"]
+           "start_from_config", "TimeSeriesStore", "install_collector",
+           "SloEngine", "fleet_merge", "objectives_from_config"]

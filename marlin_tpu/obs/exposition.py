@@ -22,6 +22,10 @@ fixture watches the prefix; :meth:`close` joins it):
   :meth:`~marlin_tpu.serving.engine.ServeEngine.kvpool_audit` invariant
   report as JSON (refcounts vs block tables vs free list vs prefix cache;
   the chaos-suite postcondition, scrapeable in production).
+- ``GET /debug/slo`` — every registered SLO scope's live evaluation
+  (:meth:`~marlin_tpu.obs.slo.SloEngine.payload`: per-objective compliance,
+  burn rate, budget remaining, breach state, recent transitions) as JSON —
+  the ops console's (``python -m marlin_tpu.obs.console``) data source.
 
 :func:`start_from_config` is the config-driven entry: it starts a server
 when ``config.obs_http_port`` is set (0 = ephemeral port), installs the
@@ -48,7 +52,8 @@ from .metrics import MetricsRegistry, get_registry
 __all__ = ["MetricsServer", "start_from_config", "register_health_provider",
            "unregister_health_provider", "health_payload",
            "register_kvpool_provider", "unregister_kvpool_provider",
-           "kvpool_payload"]
+           "kvpool_payload", "register_slo_provider",
+           "unregister_slo_provider", "slo_payload"]
 
 _ids = itertools.count()
 
@@ -57,6 +62,7 @@ _ids = itertools.count()
 _health_lock = threading.Lock()
 _health_providers: dict[str, object] = {}  # name -> callable() -> dict
 _kvpool_providers: dict[str, object] = {}  # name -> callable() -> audit dict
+_slo_providers: dict[str, object] = {}     # name -> callable() -> SLO dict
 
 #: provider states that flip readiness to 503 — an engine past "accepting"
 #: must drop out of rotation even while it finishes accepted work
@@ -89,6 +95,42 @@ def register_kvpool_provider(name: str, fn) -> None:
 def unregister_kvpool_provider(name: str) -> None:
     with _health_lock:
         _kvpool_providers.pop(name, None)
+
+
+def register_slo_provider(name: str, fn) -> None:
+    """Register an SLO probe: ``fn()`` returns an
+    :meth:`~marlin_tpu.obs.slo.SloEngine.payload` dict (or None to prune
+    itself). Engines with objectives configured self-register per replica,
+    the router registers the fleet merge; the reports ride
+    ``GET /debug/slo``. Re-registering a name replaces it."""
+    with _health_lock:
+        _slo_providers[name] = fn
+
+
+def unregister_slo_provider(name: str) -> None:
+    with _health_lock:
+        _slo_providers.pop(name, None)
+
+
+def slo_payload() -> tuple[int, dict]:
+    """(status_code, body) of the live-SLO probe — always 200 (a breached
+    SLO is an *alert*, not an endpoint failure; readiness stays /healthz's
+    job), with one entry per registered scope. A provider that raises
+    reports ``error`` instead of taking the endpoint down."""
+    with _health_lock:
+        providers = dict(_slo_providers)
+    scopes = []
+    for name, fn in sorted(providers.items()):
+        try:
+            info = fn()
+            if info is None:  # provider pruned itself (e.g. GC'd engine)
+                continue
+            info = dict(info)
+        except Exception as e:
+            info = {"error": f"{type(e).__name__}: {e}"}
+        info.setdefault("name", name)
+        scopes.append(info)
+    return 200, {"status": "ok", "scopes": scopes}
 
 
 def kvpool_payload() -> tuple[int, dict]:
@@ -168,6 +210,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self._reply(200, lines.encode(), "application/jsonl")
         elif path == "/debug/kvpool":
             code, payload = kvpool_payload()
+            self._reply(code, (json.dumps(payload) + "\n").encode(),
+                        "application/json")
+        elif path == "/debug/slo":
+            code, payload = slo_payload()
             self._reply(code, (json.dumps(payload) + "\n").encode(),
                         "application/json")
         else:

@@ -286,6 +286,13 @@ class MetricsRegistry:
                   buckets: Sequence[float] | None = None) -> _Family:
         return self._register(name, help, "histogram", labelnames, buckets)
 
+    def families(self) -> list[_Family]:
+        """Every registered family, name-sorted — the iteration surface the
+        time-series pump (obs/timeseries.py) reads; values are live objects,
+        snapshot each family's ``children()`` to read consistently."""
+        with self._lock:
+            return sorted(self._families.values(), key=lambda f: f.name)
+
     # -------------------------------------------------------------- collectors
 
     def add_collector(self, fn: Callable[[], None]) -> None:

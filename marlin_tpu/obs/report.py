@@ -33,12 +33,15 @@ orders) — the test suite goldens it.
 
 from __future__ import annotations
 
+import datetime
 import json
+import re
 import sys
+import time
 
 from .metrics import percentile
 
-__all__ = ["load_events", "trace_join", "analyze", "main",
+__all__ = ["load_events", "parse_when", "trace_join", "analyze", "main",
            "KNOWN_KINDS", "KNOWN_SERVE_EVS"]
 
 #: every EventLog record kind the package emits — the post-mortem
@@ -50,7 +53,8 @@ __all__ = ["load_events", "trace_join", "analyze", "main",
 KNOWN_KINDS = frozenset({
     "ckpt", "compile", "flight", "memory", "prefetch", "profile",
     "program", "resume", "resume_skip", "retry", "retry_deadline",
-    "retry_exhausted", "serve", "stage_times", "step_failure", "timer",
+    "retry_exhausted", "serve", "slo", "stage_times", "step_failure",
+    "timer",
 })
 
 #: the ``ev=`` discriminators of ``kind="serve"`` records (the
@@ -63,18 +67,57 @@ KNOWN_SERVE_EVS = frozenset({
 })
 
 
-def load_events(path: str) -> tuple[list[dict], int]:
+def parse_when(text: str, now: float | None = None) -> float:
+    """One ``--since``/``--until`` value as an epoch timestamp. Accepts a
+    relative ``<N>s/m/h/d ago`` (measured back from ``now``, default the
+    real clock), a bare epoch number, or an ISO-8601 datetime (a naive one
+    is taken as UTC — EventLog stamps ``time.time()``)."""
+    text = text.strip()
+    m = re.match(r"^(\d+(?:\.\d+)?)\s*([smhd])\s+ago$", text)
+    if m:
+        mult = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}[m.group(2)]
+        base = time.time() if now is None else now
+        return base - float(m.group(1)) * mult
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    try:
+        dt = datetime.datetime.fromisoformat(text)
+    except ValueError:
+        raise ValueError(
+            f"cannot parse time {text!r} (want ISO-8601, an epoch number, "
+            f"or '<N>s/m/h/d ago')") from None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return dt.timestamp()
+
+
+def load_events(path: str, since: float | None = None,
+                until: float | None = None) -> tuple[list[dict], int]:
     """(records, skipped torn/partial lines) from one JSONL file — the one
-    torn-line-tolerant parse (``EventLog.read`` delegates here)."""
+    torn-line-tolerant parse (``EventLog.read`` delegates here).
+    ``since``/``until`` (epoch seconds) window the stream on each record's
+    ``t`` stamp at load time, so every downstream section — and the CLI's
+    ``--since "5m ago"`` — analyzes only the window; records with no ``t``
+    are kept (they cannot be placed, and dropping them would hide them)."""
     records, skipped = [], 0
     with open(path) as f:
         for line in f:
             if not line.strip():
                 continue
             try:
-                records.append(json.loads(line))
+                rec = json.loads(line)
             except ValueError:
                 skipped += 1
+                continue
+            t = rec.get("t")
+            if isinstance(t, (int, float)):
+                if since is not None and t < since:
+                    continue
+                if until is not None and t > until:
+                    continue
+            records.append(rec)
     return records, skipped
 
 
@@ -327,16 +370,45 @@ def analyze(events: list[dict], skipped: int = 0) -> str:
     return "\n".join(out) + "\n"
 
 
+_USAGE = ("usage: python -m marlin_tpu.obs.report <events.jsonl> "
+          "[--since WHEN] [--until WHEN]\n"
+          "  WHEN: ISO-8601, an epoch number, or '<N>s/m/h/d ago'")
+
+
 def main(argv: list[str] | None = None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    if len(argv) != 1 or argv[0] in ("-h", "--help"):
-        print("usage: python -m marlin_tpu.obs.report <events.jsonl>",
-              file=sys.stderr)
+    argv = list(sys.argv[1:] if argv is None else argv)
+    path, since, until = None, None, None
+    it = iter(argv)
+    for a in it:
+        if a in ("-h", "--help"):
+            print(_USAGE, file=sys.stderr)
+            return 2
+        if a in ("--since", "--until"):
+            raw = next(it, None)
+            if raw is None:
+                print(f"{a} needs a value\n{_USAGE}", file=sys.stderr)
+                return 2
+            try:
+                when = parse_when(raw)
+            except ValueError as e:
+                print(f"{a}: {e}", file=sys.stderr)
+                return 2
+            if a == "--since":
+                since = when
+            else:
+                until = when
+        elif path is None:
+            path = a
+        else:
+            print(_USAGE, file=sys.stderr)
+            return 2
+    if path is None:
+        print(_USAGE, file=sys.stderr)
         return 2
     try:
-        events, skipped = load_events(argv[0])
+        events, skipped = load_events(path, since=since, until=until)
     except OSError as e:
-        print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
+        print(f"cannot read {path}: {e}", file=sys.stderr)
         return 1
     sys.stdout.write(analyze(events, skipped))
     return 0

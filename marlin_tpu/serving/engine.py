@@ -84,8 +84,11 @@ from ..obs import perf, trace as obs_trace
 from ..obs.collectors import compile_count as _compile_count
 from ..obs.exposition import (register_health_provider,
                               register_kvpool_provider,
+                              register_slo_provider,
                               unregister_health_provider,
-                              unregister_kvpool_provider)
+                              unregister_kvpool_provider,
+                              unregister_slo_provider)
+from ..obs.metrics import get_registry
 from ..utils import faults
 from .batcher import (BatchFormer, bucket_kv_bytes, bucket_program_key,
                       capture_bucket_costs, normalize_buckets, pick_bucket,
@@ -94,9 +97,9 @@ from .kvpool import (PagedGroup, PagedKVPool, PagePoolExhausted,
                      auto_num_pages, capture_paged_costs, paged_program_key,
                      warmup_paged)
 from .metrics import ServeMetrics
-from .request import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK,
-                      STATUS_REJECTED, STATUS_SHUTTING_DOWN, AdmissionQueue,
-                      Request, Result, ResultHandle)
+from .request import (SHED_REASON_PREFIX, STATUS_ERROR, STATUS_EXPIRED,
+                      STATUS_OK, STATUS_REJECTED, STATUS_SHUTTING_DOWN,
+                      AdmissionQueue, Request, Result, ResultHandle)
 
 __all__ = ["ServeEngine", "MigrationError"]
 
@@ -321,6 +324,58 @@ class ServeEngine:
                 return eng.kvpool_audit()
 
             register_kvpool_provider(name, _kvpool_report)
+        # --- serving SLOs (obs/slo.py + obs/timeseries.py) -----------------
+        # built only when objectives are configured (serve_slo) — otherwise
+        # the hot path carries literally nothing (one None check per worker
+        # iteration). The store and the SLO engine run on THIS engine's
+        # injected clock; evaluation is scrape- and worker-driven (tick is
+        # rate-limited), never a new thread.
+        self._slo = None
+        self._ts = None
+        self._ts_collector = None
+        if cfg.serve_slo:
+            from ..obs.slo import SloEngine, objectives_from_config
+            from ..obs.timeseries import TimeSeriesStore, install_collector
+
+            self._ts = TimeSeriesStore(
+                window_s=float(cfg.serve_ts_window_s),
+                bucket_s=float(cfg.serve_ts_bucket_s), clock=clock)
+            self.metrics.attach_timeseries(self._ts)
+            self._slo = SloEngine(objectives_from_config(cfg), self._ts,
+                                  scope=self._name, log=log, clock=clock)
+            # scrape-driven pump, restricted to the objectives' families:
+            # the registry is process-global (a labeled child per engine
+            # ever created) while the store is a bounded per-engine ring —
+            # an unfiltered pump would exhaust max_series in a long-lived
+            # process and starve the latency-sample feed
+            self._ts_collector = install_collector(
+                self._ts, only=self._slo.pump_families)
+            if cfg.serve_slo_shed:
+                # graceful degradation: a breach arms admission shedding at
+                # level = number of breached objectives (deeper breach ->
+                # higher priority tiers shed); clear disarms. In-flight
+                # work is never touched (request.py AdmissionQueue).
+                slack = float(cfg.serve_slo_shed_slack_s)
+
+                def _on_breach(ev, _q=self._queue, _slack=slack):
+                    breached = ev.get("breached") or ()
+                    if breached:
+                        _q.set_shed(len(breached),
+                                    reason=",".join(breached),
+                                    protect_slack_s=_slack)
+                    else:
+                        _q.clear_shed()
+
+                self._slo.add_breach_hook(_on_breach)
+
+            def _slo_report():
+                eng = ref()
+                if eng is None:
+                    unregister_slo_provider(name)
+                    return None
+                return eng._slo_payload()
+
+            register_slo_provider(name, _slo_report)
         if start:
             self.start()
 
@@ -385,6 +440,28 @@ class ServeEngine:
                                 if hb is not None else None),
         }
 
+    def _slo_payload(self) -> dict | None:
+        """The ``GET /debug/slo`` scope payload for this engine: the SLO
+        engine's evaluation (ticked on the probe, so a scrape always sees
+        a fresh-enough verdict without any poller thread) plus the health
+        block and paged-pool gauges the ops console renders as topology.
+        None when no objectives are configured (the provider prunes)."""
+        slo = self._slo
+        if slo is None:
+            return None
+        try:
+            slo.tick(self._clock())
+            p = slo.payload()
+        except Exception:  # pragma: no cover - probe must never 500
+            return None
+        p["health"] = self._health_info()
+        m = self.metrics
+        p["pages"] = {"total": m.pages_total, "used": m.pages_used,
+                      "shared": m.pages_shared}
+        p["shed_level"] = self._queue.shed_level
+        p["shed_count"] = self._queue.shed_count
+        return p
+
     def _prog_key(self, bucket) -> str:
         """The roofline-accounting key for this engine's programs at one
         bucket (cached — it sits on the per-step path). Paged programs key
@@ -444,6 +521,11 @@ class ServeEngine:
             pass
         unregister_health_provider(self._name)
         unregister_kvpool_provider(self._name)
+        unregister_slo_provider(self._name)
+        if self._ts_collector is not None:
+            get_registry().remove_collector(self._ts_collector)
+            self._ts_collector = None
+        self.metrics.attach_timeseries(None)
 
     def _join_worker(self) -> None:
         """Join until no worker generation will run again — a supervisor
@@ -631,7 +713,10 @@ class ServeEngine:
         else:
             cost = bucket_kv_bytes(self.params, self.heads, bucket,
                                    self.compute_dtype)
-        reason = self._queue.try_admit(cost)
+        reason = self._queue.try_admit(
+            cost, priority=request.priority,
+            deadline_slack_s=(request.deadline - now
+                              if request.deadline is not None else None))
         if reason is not None:
             # a drain/close-shut gate is a deterministic shutting_down
             # Result (the caller can failover/retry elsewhere); overload
@@ -641,6 +726,9 @@ class ServeEngine:
             # labeled as the backpressure it was
             if reason == self._queue.closed_reason:
                 return self._refuse(handle, STATUS_SHUTTING_DOWN, reason)
+            if (self._slo is not None
+                    and reason.startswith(SHED_REASON_PREFIX)):
+                self._slo.record_shed()
             return self._refuse(handle, STATUS_REJECTED, reason)
         entry = _Entry(request, handle, bucket, cost, now, trace=ctx)
         with self._cond:
@@ -807,6 +895,10 @@ class ServeEngine:
                     # stamp; floats assign atomically under the GIL and the
                     # watchdog tolerates any interleaving
                     self._heartbeat = time.monotonic()  # fake a live pulse
+                if self._slo is not None:
+                    # rate-limited internally (serve_slo_eval_interval_s):
+                    # per-iteration cost is one float compare
+                    self._slo.tick(self._clock())
                 faults.fire("serve.worker_crash",
                             path=threading.current_thread().name)
                 claimed = []
@@ -1318,6 +1410,12 @@ class ServeEngine:
             "top_p": float(group.top_p[slot]),
             "top_k": int(group.top_k[slot]),
             "ttft_s": group.ttft_s[slot],
+            # the request's span context rides the manifest so an adopting
+            # engine — even in another process, where no live _Entry span
+            # exists — continues the SAME trace instead of orphaning it
+            "trace": (None if e.trace is None else {
+                "trace_id": e.trace.trace_id, "span_id": e.trace.span_id,
+                "parent_id": e.trace.parent_id, "name": e.trace.name}),
         }
 
     def adopt_rows(self, frozen: dict, timeout: float | None = None) -> dict:
@@ -1528,6 +1626,20 @@ class ServeEngine:
                 bound = False
             if bound:
                 adopted.append(rid)
+                # re-activate the request's trace across the hop: a cross-
+                # process adopt has no live entry span, so rebuild it from
+                # the manifest; either way the migration itself becomes a
+                # child span, so freeze -> adopt -> result joins into one
+                # trace_id in the JSONL (tests/test_migration.py asserts)
+                base = e.trace
+                t = row.get("trace")
+                if base is None and t:
+                    base = obs_trace.SpanContext(
+                        t.get("trace_id"), t.get("span_id"),
+                        t.get("parent_id"),
+                        t.get("name") or f"serve.request.{rid}")
+                if base is not None:
+                    e.trace = base.child(f"serve.migrate.{rid}")
                 with obs_trace.use(e.trace):
                     self.metrics.record_page_event(
                         "adopt", rid=rid, pages=len(pages),
@@ -1613,6 +1725,10 @@ class ServeEngine:
                     # stamp; floats assign atomically under the GIL and the
                     # watchdog tolerates any interleaving
                     self._heartbeat = time.monotonic()  # fake a live pulse
+                if self._slo is not None:
+                    # rate-limited internally (serve_slo_eval_interval_s):
+                    # per-iteration cost is one float compare
+                    self._slo.tick(self._clock())
                 faults.fire("serve.worker_crash",
                             path=threading.current_thread().name)
                 claimed = []

@@ -145,6 +145,7 @@ class ServeMetrics:
         self._queue_s = Reservoir(keep_latencies, rng)
         self._ttft_s = Reservoir(keep_latencies, rng)
         self._step_s = Reservoir(keep_latencies, rng)
+        self._ts = None  # optional TimeSeriesStore (attach_timeseries)
         reg = get_registry()
         self._m_submitted = reg.counter(
             "marlin_serve_submitted_total", "Requests admitted by submit()")
@@ -203,6 +204,26 @@ class ServeMetrics:
             "off a frozen engine, adopt = rows resumed mid-stream on this "
             "engine, fallback = rows degraded to the retry path)",
             labelnames=("leg",))
+
+    def attach_timeseries(self, store) -> None:
+        """Feed raw latency samples into a
+        :class:`~marlin_tpu.obs.timeseries.TimeSeriesStore` so windowed
+        percentiles (the SLO engine's ``p99:...`` objectives) see every
+        observation, not just the cumulative histogram the registry pump
+        carries. Series are named after the histogram families
+        (``marlin_serve_ttft_seconds`` etc. — the pump's derived cum
+        series use ``_count``/``_sum`` suffixes, so the names never
+        collide). Pass ``None`` to detach."""
+        with self._lock:
+            self._ts = store
+
+    def _ts_observe(self, name: str, value: float) -> None:
+        ts = getattr(self, "_ts", None)
+        if ts is not None:
+            try:
+                ts.observe(name, value)
+            except Exception:
+                pass  # observability stays passive on the serving path
 
     def _emit(self, **fields) -> None:
         log = self._log or get_default_event_log()
@@ -281,6 +302,7 @@ class ServeMetrics:
         self._m_busy.inc(seconds)
         self._m_occupancy.set(rows / max_batch)
         self._m_step.observe(seconds)
+        self._ts_observe("marlin_serve_step_seconds", seconds)
         self._emit(ev="step", bucket=list(bucket), rows=rows,
                    occupancy=round(rows / max_batch, 4), new_tokens=rows,
                    seconds=seconds,
@@ -383,8 +405,12 @@ class ServeMetrics:
         self._m_requests.labels(status=status).inc()
         if total_s is not None:
             self._m_total.observe(total_s)
+            self._ts_observe("marlin_serve_total_seconds", total_s)
         if ttft_s is not None:
             self._m_ttft.observe(ttft_s)
+            self._ts_observe("marlin_serve_ttft_seconds", ttft_s)
+        if queue_s is not None:
+            self._ts_observe("marlin_serve_queue_seconds", queue_s)
         fields = {"ev": "result", "rid": rid, "status": status}
         if attempt > 1:
             fields["attempt"] = attempt

@@ -25,8 +25,15 @@ from typing import Any
 import numpy as np
 
 __all__ = ["Request", "Result", "ResultHandle", "AdmissionQueue",
+           "SHED_REASON_PREFIX",
            "STATUS_OK", "STATUS_REJECTED", "STATUS_EXPIRED", "STATUS_ERROR",
            "STATUS_SHUTTING_DOWN"]
+
+#: rejection reasons produced by SLO-driven load shedding start with this —
+#: the engine keys its marlin_slo_shed_total accounting off the prefix and
+#: callers can distinguish "shed under breach, retry elsewhere/later" from
+#: a structurally full queue
+SHED_REASON_PREFIX = "shedding load"
 
 #: terminal statuses a :class:`Result` can carry
 STATUS_OK = "ok"                          # decoded; ``tokens`` is set
@@ -172,7 +179,19 @@ class AdmissionQueue:
     .bucket_kv_bytes`). ``try_admit`` returns ``None`` on admission or the
     rejection reason string; ``release`` returns the request's capacity when
     the engine retires it. ``close(reason)`` flips the gate shut (drain /
-    shutdown) — everything after is rejected with that reason."""
+    shutdown) — everything after is rejected with that reason.
+
+    **Graceful degradation** — :meth:`set_shed` arms an SLO-breach shed
+    level: while armed, ``try_admit`` additionally rejects the *least
+    protected* new arrivals (reason prefixed :data:`SHED_REASON_PREFIX`).
+    A request's protection score is its ``priority`` plus 1 when its
+    deadline is imminent (slack ≤ ``protect_slack_s`` — work the fleet is
+    about to owe an answer for is never the first shed); a request is shed
+    iff score < level, so level 1 drops only priority-0 slack-rich
+    traffic and each further level reaches one priority tier higher.
+    In-flight work is untouched — shedding gates admission only, so
+    exactly-once delivery is preserved: every shed request still gets its
+    clean ``rejected`` Result. :meth:`clear_shed` disarms on SLO clear."""
 
     def __init__(self, depth: int, budget_bytes: int):
         if depth < 1:
@@ -183,11 +202,52 @@ class AdmissionQueue:
         self._count = 0
         self._bytes = 0
         self._closed_reason: str | None = None
+        self._shed_level = 0
+        self._shed_reason = ""
+        self._shed_slack_s = 0.0
+        self._shed_count = 0
 
-    def try_admit(self, cost_bytes: int) -> str | None:
+    def set_shed(self, level: int, reason: str = "",
+                 protect_slack_s: float = 0.0) -> None:
+        """Arm (level ≥ 1) or disarm (level 0) SLO-driven shedding.
+        ``reason`` names the breached objective(s) for the rejection
+        string; ``protect_slack_s`` is the deadline-slack bound under
+        which a request counts as imminent and gains a protection point."""
+        with self._lock:
+            self._shed_level = max(0, int(level))
+            self._shed_reason = str(reason)
+            self._shed_slack_s = float(protect_slack_s)
+
+    def clear_shed(self) -> None:
+        self.set_shed(0)
+
+    @property
+    def shed_level(self) -> int:
+        with self._lock:
+            return self._shed_level
+
+    @property
+    def shed_count(self) -> int:
+        """Total requests rejected by shedding since construction."""
+        with self._lock:
+            return self._shed_count
+
+    def try_admit(self, cost_bytes: int, priority: int = 0,
+                  deadline_slack_s: float | None = None) -> str | None:
         with self._lock:
             if self._closed_reason is not None:
                 return self._closed_reason
+            if self._shed_level > 0:
+                score = int(priority)
+                if (deadline_slack_s is not None
+                        and deadline_slack_s <= self._shed_slack_s):
+                    score += 1
+                if score < self._shed_level:
+                    self._shed_count += 1
+                    why = (f" ({self._shed_reason})" if self._shed_reason
+                           else "")
+                    return (f"{SHED_REASON_PREFIX}: SLO error budget "
+                            f"burning{why}; retry later or raise priority")
             if self._count >= self.depth:
                 return (f"queue full ({self._count}/{self.depth} requests "
                         f"pending or in flight)")

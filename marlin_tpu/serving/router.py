@@ -75,7 +75,10 @@ import numpy as np
 
 from ..config import get_config
 from ..obs.exposition import (register_health_provider,
-                              unregister_health_provider)
+                              register_slo_provider,
+                              unregister_health_provider,
+                              unregister_slo_provider)
+from ..obs.slo import fleet_merge
 from ..obs.metrics import get_registry
 from ..utils import faults
 from .engine import MigrationError
@@ -223,6 +226,10 @@ class Router:
             for rep in self._replicas:
                 rep.engine.warmup()
         register_health_provider(self._name, self._health_info)
+        # fleet-wide SLO view: the replicas' per-engine /debug/slo scopes
+        # stay registered (drill-down); the router adds the worst-case
+        # merge (obs/slo.py fleet_merge) under its own name
+        register_slo_provider(self._name, self._fleet_slo)
         self._publish_states()
 
     # -------------------------------------------------------------- plumbing
@@ -568,6 +575,7 @@ class Router:
                 rep.engine.close()
         self._publish_states()
         unregister_health_provider(self._name)
+        unregister_slo_provider(self._name)
 
     def __enter__(self):
         return self
@@ -580,6 +588,29 @@ class Router:
     def pending(self) -> int:
         with self._lock:
             return sum(r.engine.pending() for r in self._replicas)
+
+    def _fleet_slo(self) -> dict | None:
+        """The fleet scope for ``GET /debug/slo``: every live replica's SLO
+        payload worst-case-merged (:func:`~marlin_tpu.obs.slo.fleet_merge`)
+        so one burning replica surfaces at the top level with its name.
+        None (provider prunes) when no replica has objectives configured."""
+        with self._lock:
+            if self._closed:
+                return None
+            reps = list(self._replicas)
+        payloads = []
+        for rep in reps:
+            try:
+                p = rep.engine._slo_payload()
+            except Exception:
+                p = None
+            if p is not None:
+                payloads.append(p)
+        if not payloads:
+            return None
+        merged = fleet_merge(payloads)
+        merged["router"] = self._name
+        return merged
 
     def _health_info(self) -> dict:
         """The aggregated /healthz payload: ready while ANY replica

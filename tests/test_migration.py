@@ -299,6 +299,69 @@ def test_freeze_adopt_midstream_bit_identical(params):
         a.close(), b.close()
 
 
+def test_migration_trace_continuity_cross_process(params, tmp_path):
+    """Migration-proof traces: rows frozen on A and adopted on B — with
+    the live in-process span objects stripped, exactly as a cross-process
+    hop would arrive — keep ONE trace_id from enqueue on A through adopt
+    and result on B, and the adopt-side hop span (serve.migrate.<rid>)
+    parents into the exact span the freeze manifest carried."""
+    from marlin_tpu.utils.tracing import EventLog, set_default_event_log
+
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    prev = set_default_event_log(log)
+    a, b = _engine(params), _engine(params)
+    a.warmup(), b.warmup()
+    try:
+        with faults.injected("serve.decode_step",
+                             DelayFault(seconds=0.4, times=1,
+                                        schedule=Schedule(fire_on=[2]))):
+            hs = [a.submit(Request(prompt=[3, 1 + i % 4, 2], steps=8))
+                  for i in range(8)]
+            time.sleep(0.1)
+        frozen = a.freeze_rows()
+        assert frozen is not None and frozen["entries"]
+        # every live row captured its submit-time span; remember it, then
+        # strip the in-process objects so ONLY the manifest can carry the
+        # trace across the hop (what a pickle/process boundary does)
+        orig = {rid: e.trace for rid, e in frozen["entries"].items()}
+        assert all(t is not None for t in orig.values())
+        for e in frozen["entries"].values():
+            e.trace = None
+        res = b.adopt_rows(frozen)
+        for rid in res["adopted"]:
+            a._queue.release(frozen["entries"][rid].cost)
+        assert b.adopt_entries(frozen["queued"] + res["fallback"])
+        for e in frozen["queued"] + res["fallback"]:
+            a._queue.release(e.cost)
+        a.close()
+        for h in hs:
+            r = h.result(timeout=120)
+            assert r.status == STATUS_OK, (r.status, r.reason)
+        assert res["adopted"]  # the continuity claim needs real adoptions
+        b.drain()
+    finally:
+        a.close(), b.close()
+        set_default_event_log(prev)
+        log.close()
+    serve = [r for r in log.read() if r["kind"] == "serve"]
+    by_rid = {}
+    for rec in serve:
+        if "rid" in rec and "trace_id" in rec:
+            by_rid.setdefault(rec["rid"], []).append(rec)
+    for rid in res["adopted"]:
+        recs = by_rid.get(rid, [])
+        assert recs, f"rid {rid} left no traced serve records"
+        tids = {r["trace_id"] for r in recs}
+        assert tids == {orig[rid].trace_id}, (rid, tids)
+        evs = {r.get("ev") for r in recs}
+        assert "result" in evs  # B retired it inside the SAME trace
+        hops = [r for r in recs
+                if r.get("ev") == "page" and r.get("action") == "adopt"]
+        assert hops, f"rid {rid} adopt record missing"
+        for rec in hops:
+            assert rec.get("parent_id") == orig[rid].span_id, (rid, rec)
+
+
 def test_adopt_rows_rejects_wrong_target(params):
     """adopt_rows on a non-running engine raises MigrationError instead of
     silently losing the frozen work (the router falls back to the retry

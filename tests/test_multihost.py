@@ -12,6 +12,17 @@ import sys
 import numpy as np
 import pytest
 
+import jax as _jax_mod
+
+# jax-0.4.37-era gate: these cases exercise behaviour that only works in
+# the top-level jax.shard_map / jax.typeof era (partial-auto shard_map,
+# scan-carry replication checks) -- same class as tests/test_aot_tpu.py.
+needs_modern_jax = pytest.mark.skipif(
+    getattr(_jax_mod, "shard_map", None) is None
+    or not hasattr(_jax_mod, "typeof"),
+    reason="needs modern jax (top-level shard_map / typeof era)")
+
+
 _WORKER = r"""
 import os, sys
 proc_id = int(sys.argv[1])
@@ -275,12 +286,14 @@ def _launch(run_dir, nproc, mode, ckpt_dir, marker):
 
 @pytest.mark.skipif(os.environ.get("MARLIN_SKIP_MULTIHOST") == "1",
                     reason="multi-host test disabled")
+@needs_modern_jax
 def test_two_process_mesh(tmp_path):
     _launch(tmp_path / "run", 2, "matmul", tmp_path, "global sum ok")
 
 
 @pytest.mark.skipif(os.environ.get("MARLIN_SKIP_MULTIHOST") == "1",
                     reason="multi-host test disabled")
+@needs_modern_jax
 def test_two_process_checkpoint_restore(tmp_path):
     # save in one 2-process job, restore in a second (fresh coordinator,
     # fresh mesh) — the crash-recovery sequence SURVEY.md §5.3/§5.4 demands
@@ -291,6 +304,7 @@ def test_two_process_checkpoint_restore(tmp_path):
 
 @pytest.mark.skipif(os.environ.get("MARLIN_SKIP_MULTIHOST") == "1",
                     reason="multi-host test disabled")
+@needs_modern_jax
 def test_process_elastic_2_to_1(tmp_path):
     """Train under 2 processes (8 devices, 4x2), lose a process, resume the
     SAME ResilientLoop trajectory under 1 process (4 devices, 2x2). The save
@@ -304,6 +318,7 @@ def test_process_elastic_2_to_1(tmp_path):
 
 @pytest.mark.skipif(os.environ.get("MARLIN_SKIP_MULTIHOST") == "1",
                     reason="multi-host test disabled")
+@needs_modern_jax
 def test_process_elastic_1_to_2(tmp_path):
     """The reverse: a 1-process world saves (single-file layout), a 2-process
     world resumes it onto a process-spanning mesh — scale-UP elasticity."""
@@ -315,6 +330,7 @@ def test_process_elastic_1_to_2(tmp_path):
 
 @pytest.mark.skipif(os.environ.get("MARLIN_SKIP_MULTIHOST") == "1",
                     reason="multi-host test disabled")
+@needs_modern_jax
 def test_latest_pointer_single_writer(tmp_path):
     """save_checkpoint through a remote-FS hook: the 'latest' pointer is
     written by process 0 alone (object stores make concurrent same-object

@@ -518,3 +518,124 @@ def test_report_main_cli(tmp_path, capsys):
 
 def test_report_empty_stream():
     assert analyze([]) == "== marlin_tpu.obs.report ==\nevents: 0\n"
+
+
+# --------------------------------------------------- analysis time windows
+
+
+def test_parse_when_forms():
+    from marlin_tpu.obs.report import parse_when
+
+    assert parse_when("1234.5") == 1234.5
+    assert parse_when("5m ago", now=1000.0) == 700.0
+    assert parse_when("2h ago", now=10000.0) == 10000.0 - 7200.0
+    assert parse_when("30s ago", now=100.0) == 70.0
+    assert parse_when("1d ago", now=90000.0) == 90000.0 - 86400.0
+    # ISO-8601; a naive stamp is taken as UTC (EventLog stamps time.time())
+    assert parse_when("1970-01-01T00:10:00+00:00") == 600.0
+    assert parse_when("1970-01-01T00:10:00") == 600.0
+    with pytest.raises(ValueError, match="cannot parse time"):
+        parse_when("next tuesday")
+
+
+def test_load_events_window():
+    all_events, _ = load_events(FIXTURE)
+    windowed, skipped = load_events(FIXTURE, since=1004.0, until=1009.0)
+    assert skipped == 1
+    assert 0 < len(windowed) < len(all_events)
+    assert all(1004.0 <= r["t"] <= 1009.0 for r in windowed)
+    # a record with no numeric t is kept, not silently dropped
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        f.write(json.dumps({"kind": "x", "t": 5.0}) + "\n")
+        f.write(json.dumps({"kind": "y"}) + "\n")
+        path = f.name
+    try:
+        recs, _ = load_events(path, since=100.0)
+        assert [r["kind"] for r in recs] == ["y"]
+    finally:
+        os.unlink(path)
+
+
+WINDOW_GOLDEN = os.path.join(os.path.dirname(__file__), "..", "tools",
+                             "fixtures", "obs_report_window_golden.txt")
+
+
+def test_report_cli_since_until_golden(capsys):
+    from marlin_tpu.obs.report import main
+
+    assert main(["--since", "1004", "--until", "1009", FIXTURE]) == 0
+    out = capsys.readouterr().out
+    with open(WINDOW_GOLDEN) as f:
+        assert out == f.read()
+    # flag error paths fail loudly with usage, not a traceback
+    assert main(["--since"]) == 2
+    assert main(["--since", "next tuesday", FIXTURE]) == 2
+    assert main(["--since", "1004", FIXTURE, "extra.jsonl"]) == 2
+    capsys.readouterr()
+
+
+# ----------------------------------------------- concurrent scrape stress
+
+
+def test_concurrent_scrape_no_torn_exposition(lm_params, default_log):
+    """8 client threads hammering /metrics, /healthz, /debug/slo and
+    /debug/kvpool during a live serve: every response is well-formed (no
+    500s, no torn exposition) and the serve is undisturbed."""
+    import marlin_tpu as mt
+    from marlin_tpu.serving import Request, ServeEngine
+
+    slo = ({"name": "ttft", "metric": "p95:marlin_serve_ttft_seconds",
+            "target": 30.0, "window_s": 60.0},)
+    with mt.config_context(serve_slo=slo, serve_slo_eval_interval_s=0.05,
+                           serve_ts_bucket_s=0.5):
+        eng = ServeEngine(lm_params, HEADS, buckets=((8, 4),), max_batch=4,
+                          max_wait_ms=0.0, queue_depth=64)
+    failures: list[str] = []
+
+    def hammer(base, n=6):
+        paths = ("/metrics", "/healthz", "/debug/slo", "/debug/kvpool")
+        for i in range(n):
+            for p in paths:
+                try:
+                    with urllib.request.urlopen(base + p, timeout=10) as r:
+                        body = r.read().decode()
+                        code = r.status
+                except urllib.error.HTTPError as e:
+                    body, code = e.read().decode(), e.code
+                except Exception as e:  # connection-level failure
+                    failures.append(f"{p}: {type(e).__name__}: {e}")
+                    continue
+                if code >= 500:
+                    failures.append(f"{p}: HTTP {code}")
+                elif p == "/metrics":
+                    if (not body.endswith("\n")
+                            or "# TYPE marlin_serve_submitted_total"
+                            not in body):
+                        failures.append(f"{p}: torn exposition")
+                else:
+                    try:
+                        json.loads(body)
+                    except ValueError:
+                        failures.append(f"{p}: torn JSON body")
+
+    try:
+        with obs.MetricsServer(port=0) as srv:
+            base = srv.url.rsplit("/metrics", 1)[0]
+            handles = [eng.submit(Request(prompt=[1, 2, i % 7 + 1],
+                                          steps=3))
+                       for i in range(16)]
+            threads = [threading.Thread(target=hammer, args=(base,))
+                       for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads)
+            results = [h.result(timeout=60) for h in handles]
+    finally:
+        eng.close()
+    assert not failures, failures[:10]
+    assert all(r.ok for r in results)

@@ -1,0 +1,620 @@
+"""Fleet SLO engine suite (obs/timeseries.py, obs/slo.py, obs/console.py,
+serving-side shedding; docs/observability.md "Serving SLOs").
+
+The load-bearing test is the e2e: a latency spike on a live engine must
+drive the fast-burn breach within one evaluation window, the breach must
+arm admission shedding (clean reject-with-reason, exactly-once — never a
+dropped or half-processed request), and the hysteresis clear must release
+it — all on an injected clock, no sleeps. Everything else is the unit
+coverage underneath: the windowed store's ring semantics, the objective
+grammar's loud failures, the burn state machine, the fleet merge, the
+/debug/slo provider lifecycle, and the console's pure render.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import marlin_tpu as mt
+from marlin_tpu.obs import console
+from marlin_tpu.obs.exposition import slo_payload
+from marlin_tpu.obs.metrics import MetricsRegistry
+from marlin_tpu.obs.slo import (SloEngine, fleet_merge, parse_objective)
+from marlin_tpu.obs.timeseries import TimeSeriesStore, pump_registry
+from marlin_tpu.serving import (STATUS_OK, STATUS_REJECTED, Request,
+                                ServeEngine)
+from marlin_tpu.serving.request import SHED_REASON_PREFIX, AdmissionQueue
+from marlin_tpu.utils import faults
+from marlin_tpu.utils.tracing import EventLog, set_default_event_log
+
+HEADS = 2
+
+
+class FakeClock:
+    """Deterministic clock: only advances when the test says so."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def params():
+    from marlin_tpu.models import TransformerLM
+
+    return TransformerLM(vocab=32, d_model=16, heads=HEADS, layers=2,
+                         seed=9).init_params()
+
+
+@pytest.fixture()
+def default_log(tmp_path):
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    prev = set_default_event_log(log)
+    yield log
+    set_default_event_log(prev)
+    log.close()
+
+
+# ------------------------------------------------------------- time series
+
+
+def test_store_counter_delta_rate_windows():
+    clk = FakeClock(100.0)
+    st = TimeSeriesStore(window_s=60.0, bucket_s=5.0, clock=clk)
+    st.add("hits", 3.0)
+    clk.advance(10.0)
+    st.add("hits", 7.0)
+    assert st.delta("hits", 30.0) == 10.0
+    assert st.delta("hits", 5.0) == 7.0  # trailing bucket only
+    assert st.rate("hits", 20.0) == pytest.approx(10.0 / 20.0)
+    # beyond the ring the old bucket is recycled, not double-counted
+    clk.advance(120.0)
+    assert st.delta("hits", 60.0) == 0.0
+
+
+def test_store_record_cum_reset_and_first_counts():
+    clk = FakeClock(0.0)
+    st = TimeSeriesStore(window_s=60.0, bucket_s=1.0, clock=clk)
+    # default: the first reading only baselines (a cumulative counter's
+    # standing value predates the window)
+    st.record_cum("c", 100.0)
+    assert st.delta("c", 60.0) == 0.0
+    st.record_cum("c", 104.0)
+    assert st.delta("c", 60.0) == 4.0
+    # a reset (value going backwards) counts the new value from zero
+    st.record_cum("c", 1.0)
+    assert st.delta("c", 60.0) == 5.0
+    # first_counts: a series that shows up while its family is already
+    # watched charges its first reading in full (the labeled-child case:
+    # the bare family baselined at t0, the child appeared later)
+    assert not st.watched("d")
+    st.record_cum("d", 6.0, first_counts=True)
+    assert st.watched("d")
+    assert st.delta("d", 60.0) == 6.0
+
+
+def test_store_samples_pct_mean_gauge_last():
+    clk = FakeClock(50.0)
+    st = TimeSeriesStore(window_s=30.0, bucket_s=1.0, clock=clk)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        st.observe("lat", v)
+    assert st.mean("lat", 10.0) == pytest.approx(2.5)
+    from marlin_tpu.serving import percentile
+
+    assert st.pct("lat", 50.0, 10.0) == percentile([1.0, 2.0, 3.0, 4.0], 50.0)
+    assert sorted(st.values("lat", 10.0)) == [1.0, 2.0, 3.0, 4.0]
+    st.set("g", 7.0)
+    clk.advance(2.0)
+    st.set("g", 9.0)
+    assert st.last("g", 10.0) == 9.0
+    clk.advance(60.0)  # everything ages out of the ring
+    assert st.values("lat", 10.0) == []
+    assert st.last("g", 10.0) is None
+
+
+def test_pump_registry_counters_gauges_and_labeled_children():
+    clk = FakeClock(10.0)
+    st = TimeSeriesStore(window_s=60.0, bucket_s=1.0, clock=clk)
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "h", labelnames=("status",))
+    g = reg.gauge("depth", "h")
+    c.labels(status="ok").inc(3)
+    g.set(5.0)
+    pump_registry(st, reg, clk())  # baselines the family
+    clk.advance(2.0)
+    c.labels(status="ok").inc(4)
+    c.labels(status="err").inc(2)  # NEW child after the baseline pump
+    g.set(8.0)
+    pump_registry(st, reg, clk())
+    # family total diffs from its baseline; the late child counts in full
+    # (first_counts) because the family was already watched
+    assert st.delta("req_total", 30.0) == 6.0
+    assert st.delta('req_total{status=ok}', 30.0) == 4.0
+    assert st.delta('req_total{status=err}', 30.0) == 2.0
+    assert st.last("depth", 30.0) == 8.0
+
+
+def test_pump_only_keeps_store_bounded():
+    """Regression: the process-global registry grows a labeled child per
+    engine ever created; an unfiltered pump crowds a bounded per-engine
+    store past max_series and then silently REFUSES the latency-sample
+    feed — the e2e SLO eval reads an empty window forever. The SLO tick
+    pumps only its objectives' families."""
+    from marlin_tpu.obs.slo import pump_families
+
+    objs = [parse_objective({"name": "ttft",
+                             "metric": "p95:marlin_serve_ttft_seconds",
+                             "target": 0.05, "window_s": 30}),
+            parse_objective({"name": "avail",
+                             "metric": "ratio:req_total{status=ok}/"
+                                       "req_total",
+                             "target": 0.99, "window_s": 60}),
+            parse_objective({"name": "qmean",
+                             "metric": "mean:lat_seconds_count",
+                             "target": 1.0, "window_s": 30})]
+    fams = pump_families(objs)
+    # label suffixes stripped, histogram derivatives map to their family
+    assert {"marlin_serve_ttft_seconds", "req_total",
+            "lat_seconds_count", "lat_seconds"} <= fams
+    clk = FakeClock(10.0)
+    st = TimeSeriesStore(window_s=60.0, bucket_s=1.0, clock=clk,
+                         max_series=8)
+    reg = MetricsRegistry()
+    noise = reg.counter("noise_total", "h", labelnames=("scope",))
+    for i in range(32):  # 4x the store cap
+        noise.labels(scope=f"eng-{i}").inc()
+    reg.counter("req_total", "h", labelnames=("status",)) \
+        .labels(status="ok").inc(5)
+    pump_registry(st, reg, clk(), only=fams)
+    assert st.dropped_series == 0
+    assert not any(n.startswith("noise_total") for n in st.names())
+    # the latency feed still lands after many pump cycles
+    for _ in range(4):
+        clk.advance(1.0)
+        pump_registry(st, reg, clk(), only=fams)
+    st.observe("marlin_serve_ttft_seconds", 0.02)
+    assert st.values("marlin_serve_ttft_seconds", 10.0) == [0.02]
+    # unfiltered pump on the same flooded registry does exhaust the cap —
+    # the failure mode the filter exists for
+    st2 = TimeSeriesStore(window_s=60.0, bucket_s=1.0, clock=clk,
+                          max_series=8)
+    pump_registry(st2, reg, clk())
+    st2.observe("marlin_serve_ttft_seconds", 0.02)
+    assert st2.dropped_series > 0
+    assert st2.values("marlin_serve_ttft_seconds", 10.0) == []
+
+
+# ------------------------------------------------------- objective grammar
+
+
+def test_parse_objective_percentile_defaults():
+    o = parse_objective({"name": "ttft",
+                         "metric": "p95:marlin_serve_ttft_seconds",
+                         "target": 0.5, "window_s": 300})
+    assert (o.agg, o.q, o.op) == ("pct", 95.0, "<=")
+    assert o.budget == pytest.approx(0.05)
+    o = parse_objective({"name": "t", "metric": "p999:x", "target": 1,
+                         "window_s": 10})
+    assert o.q == pytest.approx(99.9)
+    assert o.budget == pytest.approx(0.001)
+
+
+def test_parse_objective_ratio_and_overrides():
+    o = parse_objective({
+        "name": "avail",
+        "metric": "ratio:req_total{status=ok}/req_total",
+        "target": 0.99, "window_s": 60})
+    assert (o.agg, o.good, o.total, o.op) == (
+        "ratio", "req_total{status=ok}", "req_total", ">=")
+    assert o.budget == pytest.approx(0.01)
+    o = parse_objective({"name": "g", "metric": "gauge:depth", "target": 10,
+                         "window_s": 60, "op": ">=", "budget": 0.25})
+    assert (o.op, o.budget) == (">=", 0.25)
+
+
+@pytest.mark.parametrize("spec", [
+    {"name": "x", "metric": "p95:lat", "target": 1},          # no window
+    {"name": "x", "metric": "p95:lat", "target": 1, "window_s": 0},
+    {"name": "x", "metric": "lat", "target": 1, "window_s": 1},  # no agg
+    {"name": "x", "metric": "p0:lat", "target": 1, "window_s": 1},
+    {"name": "x", "metric": "max:lat", "target": 1, "window_s": 1},
+    {"name": "x", "metric": "ratio:good", "target": 1, "window_s": 1},
+    {"name": "x", "metric": "ratio:g/t", "target": 2, "window_s": 1},
+    {"name": "x", "metric": "p95:lat", "target": 1, "window_s": 1,
+     "op": "=="},
+    {"name": "x", "metric": "p95:lat", "target": 1, "window_s": 1,
+     "budget": 0},
+])
+def test_parse_objective_rejects_malformed(spec):
+    with pytest.raises(ValueError):
+        parse_objective(spec)
+
+
+# ----------------------------------------------------- burn state machine
+
+
+def _slo_engine(clk, store, reg, **kw):
+    kw.setdefault("scope", "unit")
+    kw.setdefault("eval_interval_s", 1.0)
+    kw.setdefault("fast_window_s", 10.0)
+    kw.setdefault("burn_threshold", 5.0)
+    kw.setdefault("hysteresis", 2)
+    return SloEngine(
+        [{"name": "lat", "metric": "p90:lat", "target": 0.1,
+          "window_s": 30.0}],
+        store, registry=reg, clock=clk, **kw)
+
+
+def test_burn_breach_hysteresis_and_hooks():
+    clk = FakeClock(100.0)
+    st = TimeSeriesStore(window_s=60.0, bucket_s=1.0, clock=clk)
+    reg = MetricsRegistry()
+    eng = _slo_engine(clk, st, reg)
+    seen = []
+    eng.add_breach_hook(lambda ev: seen.append((ev["state"],
+                                               tuple(ev["breached"]))))
+    # empty window: unknown, never a breach
+    recs = eng.evaluate()
+    assert recs[0]["value"] is None and not recs[0]["breached"]
+    # healthy traffic
+    for _ in range(5):
+        st.observe("lat", 0.01)
+    assert not eng.evaluate()[0]["breached"]
+    # spike: p90 over the fast window blows the target, burn >= threshold
+    for _ in range(5):
+        st.observe("lat", 2.0)
+    rec = eng.evaluate()[0]
+    assert rec["breached"] and rec["burn_rate"] >= 5.0
+    assert eng.breached() == ["lat"]
+    assert seen == [("breach", ("lat",))]
+    # burn still hot: no flapping, still breached
+    assert eng.evaluate()[0]["breached"]
+    # spike ages out of the fast window -> two quiet evals clear it
+    clk.advance(15.0)
+    assert eng.evaluate()[0]["breached"]      # clear_streak 1 of 2
+    clk.advance(1.0)
+    assert not eng.evaluate()[0]["breached"]  # hysteresis reached
+    assert seen == [("breach", ("lat",)), ("clear", ())]
+    # gauges track live state
+    fam = {f.name for f in reg.families()}
+    assert {"marlin_slo_compliance", "marlin_slo_budget_remaining",
+            "marlin_slo_burn_rate", "marlin_slo_breached",
+            "marlin_slo_shed_total"} <= fam
+
+
+def test_tick_rate_limited_and_payload():
+    clk = FakeClock(100.0)
+    st = TimeSeriesStore(window_s=60.0, bucket_s=1.0, clock=clk)
+    reg = MetricsRegistry()
+    eng = _slo_engine(clk, st, reg)
+    assert eng.tick() is not None
+    assert eng.tick() is None           # within eval_interval_s
+    clk.advance(1.5)
+    assert eng.tick() is not None
+    p = eng.payload()
+    assert p["scope"] == "unit" and len(p["objectives"]) == 1
+    assert p["objectives"][0]["slo"] == "lat"
+
+
+def test_fleet_merge_worst_case():
+    a = {"scope": "r0", "objectives": [
+        {"slo": "ttft", "compliance": 0.99, "budget_remaining": 0.9,
+         "burn_rate": 0.5, "breached": False, "value": 0.2, "target": 0.5}],
+        "events": []}
+    b = {"scope": "r1", "objectives": [
+        {"slo": "ttft", "compliance": 0.42, "budget_remaining": 0.0,
+         "burn_rate": 9.0, "breached": True, "value": 1.8, "target": 0.5}],
+        "events": [{"slo": "ttft", "state": "breach"}]}
+    m = fleet_merge([a, b])
+    assert m["scope"] == "fleet"
+    (o,) = m["objectives"]
+    assert o["replicas"] == 2 and o["worst"] == "r1"
+    assert o["compliance"] == 0.42 and o["burn_rate"] == 9.0
+    assert o["breached"] and o["value"] == 1.8
+    assert m["events"][0]["scope"] == "r1"
+
+
+# --------------------------------------------------------- admission shed
+
+
+def test_admission_shed_scoring_and_release():
+    q = AdmissionQueue(8, 0)
+    q.set_shed(1, reason="ttft", protect_slack_s=2.0)
+    why = q.try_admit(1, priority=0)
+    assert why is not None and why.startswith(SHED_REASON_PREFIX)
+    assert "ttft" in why
+    assert q.try_admit(1, priority=1) is None          # priority protects
+    # imminent deadline protects a low-priority request
+    assert q.try_admit(1, priority=0, deadline_slack_s=1.5) is None
+    assert q.try_admit(1, priority=0, deadline_slack_s=10.0) is not None
+    assert q.shed_count == 2
+    q.clear_shed()
+    assert q.shed_level == 0
+    assert q.try_admit(1, priority=0) is None
+
+
+# ------------------------------------------------------------------- e2e
+
+
+class _HoldFault(faults.Fault):
+    """Block the worker at the fault point until the test releases it —
+    the deterministic latency spike (no sleeps: the gate event tells the
+    test the worker arrived, the release event lets it continue)."""
+
+    def __init__(self, gate, release, **kw):
+        super().__init__(**kw)
+        self._gate = gate
+        self._release = release
+
+    def on_fire(self, point, ctx):
+        self._gate.set()
+        self._release.wait(timeout=60)
+
+
+_SLO = (
+    {"name": "ttft", "metric": "p95:marlin_serve_ttft_seconds",
+     "target": 0.05, "window_s": 30.0},
+)
+
+
+def test_slo_e2e_breach_shed_recover(params, default_log):
+    """Latency spike -> fast-burn breach within one eval window -> clean
+    sheds with exactly-once preserved -> hysteresis recovery. Injected
+    clock, no sleeps."""
+    clk = FakeClock(1000.0)
+    with mt.config_context(serve_slo=_SLO, serve_slo_eval_interval_s=1.0,
+                           serve_slo_fast_window_s=10.0,
+                           serve_slo_burn_fast=5.0, serve_slo_hysteresis=2,
+                           serve_ts_bucket_s=1.0,
+                           serve_slo_shed_slack_s=2.0):
+        eng = ServeEngine(params, HEADS, buckets=((8, 4),), max_batch=4,
+                          max_wait_ms=0.0, queue_depth=16, page_len=4,
+                          num_pages=256, clock=clk, hbm_budget_bytes=0)
+    try:
+        eng.warmup()
+        # --- healthy phase: ttft ~0 on the frozen clock, fully compliant
+        hs = [eng.submit(Request(prompt=[1 + i, 2, 3], steps=3))
+              for i in range(3)]
+        assert all(h.result(timeout=30).ok for h in hs)
+        clk.advance(1.5)
+        p = eng._slo_payload()
+        (rec,) = p["objectives"]
+        assert rec["slo"] == "ttft" and not rec["breached"]
+        assert rec["compliance"] == 1.0 and p["shed_level"] == 0
+        # --- spike: hold the worker inside the first prefill, advance the
+        # clock 2 s while 4 requests wait, then release — every ttft ~2 s
+        gate, release = threading.Event(), threading.Event()
+        faults.inject("serve.prefill",
+                      _HoldFault(gate, release, times=1))
+        try:
+            hs = [eng.submit(Request(prompt=[2 + i, 3, 4], steps=3))
+                  for i in range(4)]
+            assert gate.wait(timeout=30)
+            clk.advance(2.0)
+        finally:
+            release.set()
+        assert all(h.result(timeout=30).ok for h in hs)
+        clk.advance(1.5)
+        p = eng._slo_payload()
+        (rec,) = p["objectives"]
+        assert rec["breached"], rec
+        assert rec["burn_rate"] >= 5.0
+        assert p["shed_level"] == 1
+        # --- shedding: a low-priority submit is cleanly rejected with the
+        # shed reason (exactly-once: the handle reaches a terminal Result),
+        # a high-priority one still serves
+        h_low = eng.submit(Request(prompt=[1, 2, 3], steps=2))
+        r = h_low.result(timeout=30)
+        assert r.status == STATUS_REJECTED
+        assert r.reason.startswith(SHED_REASON_PREFIX), r.reason
+        h_high = eng.submit(Request(prompt=[1, 2, 3], steps=2, priority=1))
+        assert h_high.result(timeout=30).status == STATUS_OK
+        assert p["shed_count"] == 0  # count reads at next payload
+        assert eng._queue.shed_count == 1
+        # --- recovery: the spike ages out of the fast window; hysteresis
+        # needs two quiet evaluations to clear, then admission reopens
+        clk.advance(12.0)
+        p = eng._slo_payload()
+        assert p["objectives"][0]["breached"]   # clear_streak 1 of 2
+        clk.advance(1.5)
+        p = eng._slo_payload()
+        assert not p["objectives"][0]["breached"]
+        assert p["shed_level"] == 0
+        h = eng.submit(Request(prompt=[1, 2, 3], steps=2))
+        assert h.result(timeout=30).status == STATUS_OK
+    finally:
+        faults.clear("serve.prefill")
+        eng.close()
+    # the transitions landed as kind="slo" EventLog records
+    slo_recs = [r for r in default_log.read() if r["kind"] == "slo"]
+    states = [r.get("state") for r in slo_recs]
+    assert "breach" in states and "clear" in states
+    # shed accounting reached the registry counter
+    from marlin_tpu.obs.metrics import get_registry
+
+    text = get_registry().render()
+    assert "marlin_slo_shed_total" in text
+
+
+def test_debug_slo_provider_payload_and_prune(params):
+    with mt.config_context(serve_slo=_SLO):
+        eng = ServeEngine(params, HEADS, buckets=((8, 4),), max_batch=4,
+                          max_wait_ms=0.0, queue_depth=16, page_len=4,
+                          num_pages=256)
+    try:
+        h = eng.submit(Request(prompt=[1, 2, 3], steps=2))
+        assert h.result(timeout=30).ok
+        code, payload = slo_payload()
+        assert code == 200 and payload["status"] == "ok"
+        scope = next(s for s in payload["scopes"]
+                     if s["scope"] == eng._name)
+        assert {o["slo"] for o in scope["objectives"]} == {"ttft"}
+        assert scope["health"]["state"] == "accepting"
+        assert "pages" in scope and "shed_level" in scope
+    finally:
+        eng.close()
+    # the provider self-prunes once the engine is gone
+    code, payload = slo_payload()
+    assert code == 200
+    assert all(s["scope"] != eng._name for s in payload["scopes"])
+
+
+def test_engine_without_slo_config_builds_nothing(params):
+    eng = ServeEngine(params, HEADS, buckets=((8, 4),), max_batch=4,
+                      max_wait_ms=0.0, queue_depth=16, page_len=4,
+                      num_pages=256)
+    try:
+        assert eng._slo is None and eng._ts is None
+        assert eng._slo_payload() is None
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------- console
+
+
+_METRICS_TEXT = """\
+# TYPE marlin_serve_queue_depth gauge
+marlin_serve_queue_depth 3
+marlin_serve_slot_occupancy 0.75
+marlin_serve_kv_pages_used 40
+marlin_serve_kv_pages_total 128
+marlin_slo_shed_total{slo="ttft",scope="serve-0"} 2
+marlin_serve_migrations_total{leg="export"} 4
+marlin_serve_migrations_total{leg="adopt"} 4
+garbage line that must be skipped
+"""
+
+_SLO_JSON = {
+    "status": "ok",
+    "scopes": [
+        {"scope": "serve-0",
+         "health": {"state": "accepting", "queue_depth": 3,
+                    "live_slots": 2},
+         "pages": {"total": 128, "used": 40},
+         "objectives": [
+             {"slo": "ttft", "value": 0.8, "target": 0.5,
+              "compliance": 0.82, "burn_rate": 6.4,
+              "budget_remaining": 0.0, "breached": True}],
+         "events": [
+             {"slo": "ttft", "state": "breach", "burn_rate": 6.4,
+              "value": 0.8, "target": 0.5}]},
+        {"scope": "fleet",
+         "objectives": [
+             {"slo": "ttft", "value": 0.8, "target": 0.5,
+              "compliance": 0.82, "burn_rate": 6.4,
+              "budget_remaining": 0.0, "breached": True,
+              "replicas": 1, "worst": "serve-0"}],
+         "events": []},
+    ],
+}
+
+
+def test_console_parse_metrics_and_value():
+    m = console.parse_metrics(_METRICS_TEXT)
+    assert console.metric_value(m, "marlin_serve_queue_depth") == 3
+    assert console.metric_value(m, "marlin_serve_migrations_total",
+                                leg="export") == 4
+    # sums across label sets when the filter is looser
+    assert console.metric_value(m, "marlin_serve_migrations_total") == 8
+    assert console.metric_value(m, "missing", default=-1.0) == -1.0
+
+
+def test_console_widgets():
+    assert console.bar(0.5, width=4) == "[##--]"
+    assert console.bar(2.0, width=4) == "[####]"
+    s = console.sparkline([0, 1, 2, 4], width=4)
+    assert len(s) == 4 and s[-1] == "█"
+    assert console.sparkline([], width=4) == ""
+    assert console.sparkline([0, 0], width=4) == "▁▁"
+
+
+def test_console_render_snapshot():
+    """render() is pure over captured payloads — the frame is goldened
+    byte-for-byte (tools/fixtures/slo_console_golden.txt)."""
+    import os
+
+    frame = console.render(console.parse_metrics(_METRICS_TEXT), _SLO_JSON,
+                           history={"fleet/ttft": [0.5, 2.0, 6.4]})
+    golden = os.path.join(os.path.dirname(__file__), "..", "tools",
+                          "fixtures", "slo_console_golden.txt")
+    with open(golden) as f:
+        assert frame == f.read()
+    # and the load-bearing content, independent of layout
+    assert "1 replica(s) · fleet merge" in frame
+    assert "BREACH" in frame and "serve-0" in frame
+    assert "shed=2" in frame and "export=4" in frame
+
+
+def test_console_render_empty_payloads():
+    frame = console.render({}, {})
+    assert "no SLO scopes registered" in frame
+    assert "no objectives configured" in frame
+    assert "no SLO transitions yet" in frame
+
+
+def test_console_main_once_against_live_server(params, capsys):
+    from marlin_tpu import obs
+
+    with mt.config_context(serve_slo=_SLO):
+        eng = ServeEngine(params, HEADS, buckets=((8, 4),), max_batch=4,
+                          max_wait_ms=0.0, queue_depth=16, page_len=4,
+                          num_pages=256)
+    try:
+        with obs.MetricsServer(port=0) as srv:
+            h = eng.submit(Request(prompt=[1, 2, 3], steps=2))
+            assert h.result(timeout=30).ok
+            assert console.main(["--url", srv.url.rsplit("/metrics", 1)[0],
+                                 "--once", "--no-clear"]) == 0
+    finally:
+        eng.close()
+    out = capsys.readouterr().out
+    assert "marlin ops console" in out
+    assert "ttft" in out
+    assert console.main(["--bogus"]) == 2
+
+
+def test_console_main_unreachable_is_graceful(capsys):
+    assert console.main(["--url", "http://127.0.0.1:9", "--once",
+                         "--no-clear"]) == 0
+    assert "unreachable" in capsys.readouterr().out
+
+
+# -------------------------------------------------------------- fleet e2e
+
+
+def test_router_fleet_slo_scope(params):
+    from marlin_tpu.serving import Router
+
+    with mt.config_context(serve_slo=_SLO):
+        router = Router(lambda: ServeEngine(
+            params, HEADS, buckets=((8, 4),), max_batch=4, max_wait_ms=0.0,
+            queue_depth=16, page_len=4, num_pages=256), replicas=2)
+    try:
+        hs = [router.submit(Request(prompt=[1 + i, 2, 3], steps=2))
+              for i in range(4)]
+        for h in hs:
+            assert h.result(timeout=60).ok
+        code, payload = slo_payload()
+        assert code == 200
+        fleet = next(s for s in payload["scopes"]
+                     if s.get("router") == router._name)
+        assert fleet["scope"] == "fleet"
+        (o,) = [o for o in fleet["objectives"] if o["slo"] == "ttft"]
+        assert o["replicas"] >= 1
+        # per-replica scopes stay registered for drill-down
+        replica_scopes = [s for s in payload["scopes"]
+                          if s.get("router") != router._name
+                          and s.get("objectives")]
+        assert len(replica_scopes) >= 2
+    finally:
+        router.close()
+    code, payload = slo_payload()
+    assert all(s.get("router") != router._name for s in payload["scopes"])

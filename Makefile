@@ -25,6 +25,9 @@ ooc-gate:
 obs-gate:
 	$(MAKE) -C tools obs-gate
 
+fleet-gate:
+	$(MAKE) -C tools fleet-gate
+
 # repo-aware static analysis (tools/analyze; docs/static_analysis.md):
 #   make analyze / make analyze-gate
 #   make analyze BASELINE=update REASON='why'

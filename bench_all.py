@@ -31,6 +31,7 @@ the BASELINE config list:
        tokens/s per offered rate (MARLIN_BENCH_SERVE_* env knobs scale it)
 """
 
+import collections
 import contextlib
 import json
 import os
@@ -1150,6 +1151,174 @@ def config_serve_slo(d_model=64, heads=4, layers=2, vocab=256):
                                    "delta_frac": round(delta, 4)})
 
 
+def config_fleet(d_model=64, heads=4, layers=2, vocab=256):
+    """Elastic-fleet acceptance leg (docs/serving.md "Elastic fleet"): a
+    diurnal open-loop trace — quiet, burst, quiet — served twice through a
+    Router. The elastic leg starts at ``serve_fleet_min_replicas`` and lets
+    a FleetController scale on fleet-merged SLO burn; the static control
+    leg serves the identical trace on a peak-sized fixed fleet. Records:
+
+    - ``serve_fleet`` (elastic): value = fraction of the static fleet's
+      replica-hours saved; detail carries dropped-request count, tail
+      (p95) TTFT vs the SLO target, scale-event count, and
+      ``replica-hours-saved F`` — the higher-is-better detail gate
+      tools/bench_compare.py enforces under ``make -C tools fleet-gate``.
+    - ``serve_fleet_static`` (control): the peak-sized fixed fleet's ok
+      fraction + replica-hours, the denominator of the saving.
+
+    MARLIN_BENCH_FLEET=0 skips the elastic leg (static control only).
+    MARLIN_BENCH_FLEET_PHASES ("rate:count,…", default "4:12,40:160,2:32")
+    shapes the trace, MARLIN_BENCH_FLEET_MAX (default 3) sizes the static
+    fleet and the elastic ceiling, MARLIN_BENCH_FLEET_TTFT_SLO (seconds,
+    default 0.3) sets the p95 TTFT objective the burn is computed from."""
+    import jax  # noqa: F401  (backend init before threads)
+
+    import marlin_tpu as mt
+    from marlin_tpu.models import TransformerLM
+    from marlin_tpu.serving import (FleetController, Request, Router,
+                                    ServeEngine, percentile)
+
+    elastic = os.environ.get("MARLIN_BENCH_FLEET", "1") != "0"
+    phases = [(float(r), int(c)) for r, c in
+              (p.split(":") for p in os.environ.get(
+                  "MARLIN_BENCH_FLEET_PHASES", "4:12,40:160,2:32")
+               .split(","))]
+    peak = int(os.environ.get("MARLIN_BENCH_FLEET_MAX", "3"))
+    ttft_slo = float(os.environ.get("MARLIN_BENCH_FLEET_TTFT_SLO", "0.75"))
+    n_req = sum(c for _, c in phases)
+    buckets = ((64, 32),)
+    params = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
+                           layers=layers, seed=0).init_params()
+    # the burn source: a tight p95 TTFT objective over a short window, so
+    # the burst phase's queueing shows up as burn >> 1 within seconds and
+    # the quiet phases decay back to slack
+    slo_cfg = ({"name": "ttft", "metric": "p95:marlin_serve_ttft_seconds",
+                "target": ttft_slo, "window_s": 60.0},)
+
+    def make_engine():
+        # the factory runs from controller action threads too — carry the
+        # SLO config with it so scaled-out replicas evaluate burn as well
+        # shedding off: the fleet experiment wants burn answered with
+        # topology (scale events), not with admission-level degradation
+        with mt.config_context(serve_slo=slo_cfg,
+                               serve_slo_eval_interval_s=0.25,
+                               serve_slo_fast_window_s=4.0,
+                               serve_slo_shed=False,
+                               serve_ts_bucket_s=1.0):
+            # max_batch=2: batch SLOTS are the capacity unit, so a
+            # scale-out adds real headroom even where replicas share
+            # host compute (CPU CI) — the burst queues on slots, not FLOPs
+            return ServeEngine(params, heads, buckets=buckets, max_batch=2,
+                               max_wait_ms=5.0, queue_depth=4 * n_req)
+
+    def run_trace(replicas, with_controller):
+        rng = np.random.default_rng(7)  # identical trace both legs
+        router = Router(make_engine, replicas=replicas, warmup=True)
+        ctl = None
+        # integrate replica-seconds off-thread at 50 ms so BOTH legs pay
+        # the same accounting (the controller's own counter only advances
+        # on its ticks, and the static leg has no controller at all)
+        stop, acc = threading.Event(), {"rs": 0.0}
+
+        def _integrate():
+            last = time.perf_counter()
+            while not stop.is_set():
+                stop.wait(0.05)
+                now = time.perf_counter()
+                acc["rs"] += (now - last) * router.replica_count()
+                last = now
+
+        sampler = threading.Thread(target=_integrate, daemon=True)
+        t0 = time.perf_counter()
+        sampler.start()
+        events = []
+        try:
+            if with_controller:
+                ctl = FleetController(router, max_replicas=peak,
+                                      eval_interval_s=0.25, out_burn=1.0,
+                                      in_burn=0.25, hysteresis=1,
+                                      cooldown_s=1.0, flap_window_s=6.0,
+                                      action_timeout_s=120.0)
+                ctl.start(poll_s=0.1)
+            handles, submit_ts = [], []
+            for rate, count in phases:
+                gaps = rng.exponential(1.0 / rate, count)
+                for i in range(count):
+                    time.sleep(gaps[i])
+                    plen = int(rng.integers(8, 48))
+                    submit_ts.append(time.monotonic())
+                    handles.append(router.submit(Request(
+                        prompt=rng.integers(0, vocab, plen)
+                        .astype(np.int32),
+                        steps=int(rng.integers(24, 33)))))
+            router.drain()
+            span = time.perf_counter() - t0
+            if ctl is not None:
+                events = [r for r in ctl.payload()["history"]
+                          if r["outcome"] == "ok"]
+        finally:
+            if ctl is not None:
+                ctl.close()
+            stop.set()
+            sampler.join(timeout=5.0)
+            router.close()
+        results = [h.result(timeout=0) for h in handles]
+        ok = [r for r in results if r.ok]
+        ttft = [r.metrics["ttft_s"] for r in ok
+                if r.metrics.get("ttft_s") is not None]
+        # the converged tail: requests submitted after the last scale-out
+        # landed (the fleet is at size for them) — the reaction transient
+        # ahead of it is the price of elasticity, reported separately
+        outs = [e["finished"] for e in events if e["action"] == "scale_out"]
+        steady = [r.metrics["ttft_s"]
+                  for t, r in zip(submit_ts, results)
+                  if r.ok and r.metrics.get("ttft_s") is not None
+                  and (not outs or t >= max(outs))] or ttft
+        return {"ok": len(ok), "dropped": len(results) - len(ok),
+                "span": span, "replica_seconds": acc["rs"],
+                "ttft_p95_ms": (percentile(ttft, 95) * 1e3 if ttft
+                                else 0.0),
+                "ttft_steady_p95_ms": (percentile(steady, 95) * 1e3
+                                       if steady else 0.0),
+                "events": events}
+
+    static = run_trace(peak, False)
+    record("serve_fleet_static", static["ok"] / max(1, n_req), "frac",
+           f"peak-sized static fleet ({peak} replicas): "
+           f"{static['ok']}/{n_req} ok, {static['dropped']} dropped; "
+           f"ttft p95 {static['ttft_p95_ms']:.0f} ms vs SLO "
+           f"{ttft_slo * 1e3:.0f} ms; "
+           f"{static['replica_seconds']:.1f} replica-seconds over "
+           f"{static['span']:.1f} s — the replica-hours denominator for "
+           f"serve_fleet",
+           extra={"replica_seconds": round(static["replica_seconds"], 2)})
+    if not elastic:
+        log("MARLIN_BENCH_FLEET=0: static control leg only")
+        return
+    el = run_trace(1, True)
+    saved = ((static["replica_seconds"] - el["replica_seconds"])
+             / static["replica_seconds"]) if static["replica_seconds"] \
+        else 0.0
+    kinds = collections.Counter(r["action"] for r in el["events"])
+    within = el["ttft_steady_p95_ms"] <= ttft_slo * 1e3
+    record("serve_fleet", saved, "frac saved",
+           f"elastic fleet 1..{peak} replicas: {el['ok']}/{n_req} ok, "
+           f"{el['dropped']} dropped; converged ttft p95 "
+           f"{el['ttft_steady_p95_ms']:.0f} ms vs SLO "
+           f"{ttft_slo * 1e3:.0f} ms "
+           f"({'within' if within else 'OVER'}; full-trace "
+           f"{el['ttft_p95_ms']:.0f} ms incl. reaction transient); "
+           f"{len(el['events'])} scale events ({dict(kinds)}); "
+           f"{el['replica_seconds']:.1f} replica-seconds vs static "
+           f"{static['replica_seconds']:.1f} "
+           f"(replica-hours-saved {max(0.0, saved):.3f})",
+           extra={"dropped": el["dropped"],
+                  "scale_events": len(el["events"]),
+                  "ttft_p95_ms": round(el["ttft_p95_ms"], 1),
+                  "ttft_steady_p95_ms": round(el["ttft_steady_p95_ms"], 1),
+                  "replica_seconds": round(el["replica_seconds"], 2)})
+
+
 def config_svd(m=1_000_000, n=512, k=8):
     """Top-k SVD of a tall-skinny matrix via the distributed Gramian +
     matrix-free Lanczos path (the reference's dist-eigs ARPACK mode,
@@ -1281,6 +1450,7 @@ def main():
         "moe": config_moe,
         "serve": config_serve,
         "serve_slo": config_serve_slo,
+        "fleet": config_fleet,
     }
     for k in which:
         log(f"=== config {k}")

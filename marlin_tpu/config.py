@@ -234,6 +234,47 @@ class MarlinConfig:
     # window/bucket buckets per series).
     serve_ts_window_s: float = 600.0
     serve_ts_bucket_s: float = 5.0
+    # --- elastic fleet (serving/fleet.py) ------------------------------------
+    # Fleet-size bounds the controller may scale within. The router itself
+    # never enforces these (manual add/retire is the operator's call); the
+    # controller refuses to scale past either bound.
+    serve_fleet_min_replicas: int = 1
+    serve_fleet_max_replicas: int = 8
+    # Seconds between controller evaluations on its injectable clock —
+    # ticks closer together than this are no-ops (same contract as the SLO
+    # engine's eval interval).
+    serve_fleet_eval_interval_s: float = 5.0
+    # Fleet-merged fast-window burn rate at/above which an evaluation
+    # counts toward scale-OUT (burn 1.0 = consuming the error budget
+    # exactly over the window), and at/below which it counts toward
+    # scale-IN (budget slack — capacity is going spare).
+    serve_fleet_out_burn: float = 1.0
+    serve_fleet_in_burn: float = 0.1
+    # Consecutive hot (or slack) evaluations before the controller acts —
+    # one noisy window must not resize the fleet.
+    serve_fleet_hysteresis: int = 3
+    # Seconds after any completed action during which the controller only
+    # observes (streaks still accumulate); lets the last action's effect
+    # reach the burn windows before the next decision.
+    serve_fleet_cooldown_s: float = 30.0
+    # Flap damping: a scale action in the OPPOSITE direction of the
+    # previous one is suppressed inside this window — oscillating burn
+    # thrashes streak counters, never the fleet.
+    serve_fleet_flap_window_s: float = 120.0
+    # REBALANCE trigger: the most loaded replica's queue depth must exceed
+    # the fleet mean by this factor (and be nontrivial) before the
+    # controller sheds part of its seen-prefix ownership.
+    serve_fleet_rebalance_ratio: float = 3.0
+    # Fraction of the hot replica's rendezvous weight a rebalance sheds
+    # (its weight is multiplied by 1 - frac, floored at 0.05): weighted
+    # HRW re-places exactly that share of its keys, nobody else's move.
+    serve_fleet_shed_frac: float = 0.5
+    # Single-flight action timeout: an action leg still running past this
+    # many seconds is recorded as timed out and the controller degrades to
+    # "do nothing" until the leg actually finishes (the migration paths
+    # own their own timeouts, so nothing is ever dropped — the controller
+    # just stops initiating).
+    serve_fleet_action_timeout_s: float = 60.0
     # --- autotune persistence (parallel/autotune.py) -------------------------
     # Where the empirical multiply-strategy winners persist across processes.
     # None = ~/.cache/marlin_tpu/autotune.json; "" disables the disk layer

@@ -11,6 +11,10 @@ router's, a bench's) and renders, at a poll interval:
 - **SLO compliance** — per objective: current value vs target, a
   compliance bar, the fast-window burn rate with a client-side sparkline
   (history accumulates across polls), budget remaining, breach state;
+- **elastic fleet** — when a :class:`~marlin_tpu.serving.fleet
+  .FleetController` is registered (``/debug/fleet``): replica count vs
+  bounds, the live burn streaks, the in-flight action, and the recent
+  scale-out/in/rebalance history with outcomes;
 - **event tail** — the recent SLO breach/clear transitions plus the
   migration/restart counters' movement.
 
@@ -29,7 +33,7 @@ import time
 import urllib.request
 
 __all__ = ["parse_metrics", "metric_value", "sparkline", "bar", "render",
-           "fetch", "main"]
+           "fetch", "fetch_fleet", "main"]
 
 _SPARK = "▁▂▃▄▅▆▇█"
 
@@ -120,19 +124,22 @@ def _fmt(v, digits: int = 3) -> str:
 # ----------------------------------------------------------------- render
 
 def render(metrics: dict, slo: dict, history: dict | None = None,
-           width: int = 78) -> str:
+           width: int = 78, *, fleet: dict | None = None) -> str:
     """One console frame from a parsed ``/metrics`` dict and a
     ``/debug/slo`` payload. ``history`` maps ``scope/slo`` to the burn-rate
     samples this console has seen (the sparkline source); pass None for a
-    single captured frame. Pure — the snapshot test renders captured
-    payloads byte-for-byte."""
+    single captured frame. ``fleet`` is the optional ``/debug/fleet``
+    payload — when present (a FleetController is registered) an elastic
+    fleet panel renders between the SLO table and the event tail; old
+    servers without the endpoint render identically to before. Pure — the
+    snapshot test renders captured payloads byte-for-byte."""
     lines: list[str] = []
     rule = "─" * width
     scopes = list(slo.get("scopes", ()))
-    fleet = next((s for s in scopes if s.get("scope") == "fleet"), None)
+    merge = next((s for s in scopes if s.get("scope") == "fleet"), None)
     replicas = [s for s in scopes if s.get("scope") != "fleet"]
     lines.append(f"marlin ops console · {len(replicas)} replica(s)"
-                 + (" · fleet merge" if fleet else ""))
+                 + (" · fleet merge" if merge else ""))
     lines.append(rule)
 
     # topology: router -> replicas, live state off each scope's health block
@@ -163,7 +170,7 @@ def render(metrics: dict, slo: dict, history: dict | None = None,
     lines.append(rule)
 
     # SLO table: the fleet merge when present, else every per-replica scope
-    show = [fleet] if fleet else scopes
+    show = [merge] if merge else scopes
     lines.append("  slo              value/target      compliance"
                  "             burn    budget  state")
     any_obj = False
@@ -189,6 +196,32 @@ def render(metrics: dict, slo: dict, history: dict | None = None,
     if not any_obj:
         lines.append("  (no objectives configured — set serve_slo)")
     lines.append(rule)
+
+    # elastic fleet: controller bounds/streaks + recent scale actions
+    for ctl in (fleet or {}).get("fleets", ()):
+        b = ctl.get("bounds") or {}
+        st = ctl.get("streaks") or {}
+        act = ctl.get("action")
+        lines.append(
+            f"  fleet {str(ctl.get('router', '?'))[:20]:<20} "
+            f"replicas={int(ctl.get('replicas', 0))} "
+            f"[{int(b.get('min', 0))}..{int(b.get('max', 0))}] "
+            f"burn={_fmt(ctl.get('burn'))} "
+            f"streaks hot={int(st.get('hot', 0))} "
+            f"slack={int(st.get('slack', 0))} "
+            f"imb={int(st.get('imbalance', 0))}")
+        if act:
+            lines.append(f"    action in flight: {act.get('action', '?')}"
+                         + (" (TIMED OUT)" if act.get("timed_out") else ""))
+        for rec in list(ctl.get("history", ()))[-3:]:
+            extra = f" replica={rec['replica']}" if "replica" in rec else ""
+            lines.append(f"    {rec.get('action', '?'):<10} "
+                         f"-> {rec.get('outcome', '?')}{extra}")
+        hrs = ctl.get("replica_seconds")
+        if hrs is not None:
+            lines.append(f"    replica-hours {hrs / 3600.0:.3f}")
+    if (fleet or {}).get("fleets"):
+        lines.append(rule)
 
     # event tail: SLO transitions + migration/restart counter movement
     shed = metric_value(metrics, "marlin_slo_shed_total")
@@ -223,6 +256,20 @@ def fetch(base_url: str, timeout: float = 3.0) -> tuple[dict, dict]:
     with urllib.request.urlopen(base + "/debug/slo", timeout=timeout) as r:
         slo = json.loads(r.read().decode("utf-8", "replace"))
     return metrics, slo
+
+
+def fetch_fleet(base_url: str, timeout: float = 3.0) -> dict | None:
+    """The ``/debug/fleet`` payload, or None when the server predates the
+    endpoint / no controller is registered — the console degrades to the
+    fleet-less layout either way."""
+    base = base_url.rstrip("/")
+    try:
+        with urllib.request.urlopen(base + "/debug/fleet",
+                                    timeout=timeout) as r:
+            payload = json.loads(r.read().decode("utf-8", "replace"))
+    except Exception:
+        return None
+    return payload if payload.get("fleets") else None
 
 
 def main(argv=None) -> int:
@@ -261,7 +308,7 @@ def main(argv=None) -> int:
                     history.setdefault(key, []).append(
                         o.get("burn_rate", 0.0) or 0.0)
                     del history[key][:-64]
-            frame = render(metrics, slo, history)
+            frame = render(metrics, slo, history, fleet=fetch_fleet(url))
         if clear and not once:
             sys.stdout.write("\x1b[2J\x1b[H")
         sys.stdout.write(frame)

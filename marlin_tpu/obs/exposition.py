@@ -26,6 +26,10 @@ fixture watches the prefix; :meth:`close` joins it):
   (:meth:`~marlin_tpu.obs.slo.SloEngine.payload`: per-objective compliance,
   burn rate, budget remaining, breach state, recent transitions) as JSON —
   the ops console's (``python -m marlin_tpu.obs.console``) data source.
+- ``GET /debug/fleet`` — every registered fleet controller's state
+  (:meth:`~marlin_tpu.serving.fleet.FleetController.payload`: replica
+  view, burn streaks, in-flight/recent scale actions, bounds) as JSON —
+  why the fleet is (not) resizing, scrapeable in production.
 
 :func:`start_from_config` is the config-driven entry: it starts a server
 when ``config.obs_http_port`` is set (0 = ephemeral port), installs the
@@ -53,7 +57,9 @@ __all__ = ["MetricsServer", "start_from_config", "register_health_provider",
            "unregister_health_provider", "health_payload",
            "register_kvpool_provider", "unregister_kvpool_provider",
            "kvpool_payload", "register_slo_provider",
-           "unregister_slo_provider", "slo_payload"]
+           "unregister_slo_provider", "slo_payload",
+           "register_fleet_provider", "unregister_fleet_provider",
+           "fleet_payload"]
 
 _ids = itertools.count()
 
@@ -63,6 +69,7 @@ _health_lock = threading.Lock()
 _health_providers: dict[str, object] = {}  # name -> callable() -> dict
 _kvpool_providers: dict[str, object] = {}  # name -> callable() -> audit dict
 _slo_providers: dict[str, object] = {}     # name -> callable() -> SLO dict
+_fleet_providers: dict[str, object] = {}   # name -> callable() -> fleet dict
 
 #: provider states that flip readiness to 503 — an engine past "accepting"
 #: must drop out of rotation even while it finishes accepted work
@@ -110,6 +117,41 @@ def register_slo_provider(name: str, fn) -> None:
 def unregister_slo_provider(name: str) -> None:
     with _health_lock:
         _slo_providers.pop(name, None)
+
+
+def register_fleet_provider(name: str, fn) -> None:
+    """Register a fleet-controller probe: ``fn()`` returns a
+    :meth:`~marlin_tpu.serving.fleet.FleetController.payload` dict (or
+    None to prune itself). Controllers self-register; the reports ride
+    ``GET /debug/fleet``. Re-registering a name replaces it."""
+    with _health_lock:
+        _fleet_providers[name] = fn
+
+
+def unregister_fleet_provider(name: str) -> None:
+    with _health_lock:
+        _fleet_providers.pop(name, None)
+
+
+def fleet_payload() -> tuple[int, dict]:
+    """(status_code, body) of the fleet-controller probe — always 200 (a
+    busy or cooling controller is a *state*, not an endpoint failure),
+    one entry per registered controller. A provider that raises reports
+    ``error`` instead of taking the endpoint down."""
+    with _health_lock:
+        providers = dict(_fleet_providers)
+    fleets = []
+    for name, fn in sorted(providers.items()):
+        try:
+            info = fn()
+            if info is None:  # provider pruned itself (e.g. GC'd engine)
+                continue
+            info = dict(info)
+        except Exception as e:
+            info = {"error": f"{type(e).__name__}: {e}"}
+        info.setdefault("name", name)
+        fleets.append(info)
+    return 200, {"status": "ok", "fleets": fleets}
 
 
 def slo_payload() -> tuple[int, dict]:
@@ -214,6 +256,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                         "application/json")
         elif path == "/debug/slo":
             code, payload = slo_payload()
+            self._reply(code, (json.dumps(payload) + "\n").encode(),
+                        "application/json")
+        elif path == "/debug/fleet":
+            code, payload = fleet_payload()
             self._reply(code, (json.dumps(payload) + "\n").encode(),
                         "application/json")
         else:

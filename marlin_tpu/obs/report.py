@@ -51,7 +51,7 @@ __all__ = ["load_events", "parse_when", "trace_join", "analyze", "main",
 #: static analyzer (tools/analyze, doc-sync check) fails the gate on any
 #: emission site this set does not cover.
 KNOWN_KINDS = frozenset({
-    "ckpt", "compile", "flight", "memory", "prefetch", "profile",
+    "ckpt", "compile", "fleet", "flight", "memory", "prefetch", "profile",
     "program", "resume", "resume_skip", "retry", "retry_deadline",
     "retry_exhausted", "serve", "slo", "stage_times", "step_failure",
     "timer",
@@ -61,9 +61,9 @@ KNOWN_KINDS = frozenset({
 #: serving/metrics.py table plus the supervisor/router resilience events).
 #: Same contract: emitting a serve ev missing here fails the doc-sync gate.
 KNOWN_SERVE_EVS = frozenset({
-    "breaker", "enqueue", "migrate", "page", "prefill", "reject",
-    "replica_rotate", "restart", "result", "retry", "route_failover",
-    "step",
+    "breaker", "enqueue", "migrate", "page", "prefill", "rebalance",
+    "reject", "replica_add", "replica_retire", "replica_rotate", "restart",
+    "result", "retry", "route_failover", "step",
 })
 
 

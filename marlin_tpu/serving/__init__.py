@@ -9,8 +9,9 @@ worker-loop engine with chunked prefill and a drain-safe lifecycle
 (engine.py), serving
 observability through the EventLog (metrics.py), supervised worker
 recovery with a restart circuit breaker (supervisor.py), and a
-multi-replica router with failover and drain-safe rolling restarts
-(router.py — docs/robustness.md covers the resilience layer).
+multi-replica router with failover, drain-safe rolling restarts, and
+elastic membership (router.py), driven by the SLO-burn fleet controller
+(fleet.py — docs/robustness.md covers the resilience layer).
 
 Quick start::
 
@@ -38,6 +39,7 @@ from .kvpool import (  # noqa: F401
     PagePoolExhausted,
     auto_num_pages,
 )
+from .fleet import FleetController  # noqa: F401
 from .metrics import ServeMetrics, percentile  # noqa: F401
 from .router import Router  # noqa: F401
 from .supervisor import Supervisor  # noqa: F401

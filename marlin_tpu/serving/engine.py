@@ -271,6 +271,12 @@ class ServeEngine:
         #: stays single-threaded, the requester waits on the event
         self._mig_inbox: collections.deque = collections.deque()
         self._started = False
+        #: True while warmup() compiles bucket programs on the caller's
+        #: thread — the supervisor's watchdog skips the stuck check (first
+        #: compiles routinely outlast any sane watchdog_s; crash detection
+        #: stays on), so a freshly scaled-out replica is never "recovered"
+        #: mid-warmup
+        self._warming = False
         eid = next(_engine_ids)
         self._name = f"marlin-serve-{eid}"
         # --- supervised recovery (serving/supervisor.py) -------------------
@@ -404,16 +410,20 @@ class ServeEngine:
         program identity includes the slab shape), the slot prefill +
         decode pair in slab mode (batcher.warmup_buckets). Call before the
         first submit — warmup drives the live pool."""
-        if self.paged:
-            with self._cond:  # never race a worker's lazy pool creation
-                pool = self._ensure_kvpool()
-            return warmup_paged(self.params, self.heads, self.buckets,
-                                self.max_batch, pool,
-                                self._prefill_chunk, self.compute_dtype,
-                                self.moe, kernel=self._decode_kernel)
-        return warmup_buckets(self.params, self.heads, self.buckets,
-                              self.max_batch, self.compute_dtype, self.moe,
-                              rowlevel=True)
+        self._warming = True
+        try:
+            if self.paged:
+                with self._cond:  # never race a worker's lazy pool creation
+                    pool = self._ensure_kvpool()
+                return warmup_paged(self.params, self.heads, self.buckets,
+                                    self.max_batch, pool,
+                                    self._prefill_chunk, self.compute_dtype,
+                                    self.moe, kernel=self._decode_kernel)
+            return warmup_buckets(self.params, self.heads, self.buckets,
+                                  self.max_batch, self.compute_dtype,
+                                  self.moe, rowlevel=True)
+        finally:
+            self._warming = False
 
     def pending(self) -> int:
         """Requests admitted but not yet retired (queued + in flight)."""

@@ -57,15 +57,34 @@ One engine is one worker loop on (implicitly) one device set; the ROADMAP's
   :meth:`snapshot` merges the per-replica ``ServeMetrics`` snapshots for
   tests and the bench.
 
+- **Elastic membership** (PR 16): :meth:`add_replica` factory-spawns a
+  replica, warms its prefix cache from the warmest peer, and joins it to
+  the rendezvous ring in one atomic list append (in-flight ``_candidates``
+  snapshots either see it fully or not at all); :meth:`retire_replica`
+  pulls one out of rotation FIRST (it leaves every rendezvous score list
+  immediately — no request can route to a closing replica), migrates its
+  live rows and queued backlog out over the same freeze→adopt path the
+  rolling restart uses, and removes it. Replica indices are stable and
+  never reused (a per-router counter), so the HRW mapping of surviving
+  replicas is untouched by membership changes — only keys the lost replica
+  owned re-place. :meth:`shed_weight` is the rebalance half: scoring is
+  *weighted* rendezvous hashing (at the default weight 1.0 the order is
+  exactly the classic digest order), so multiplying one hot replica's
+  weight down re-places precisely that fraction of its keys and nobody
+  else's. :class:`~marlin_tpu.serving.fleet.FleetController` drives all
+  three off the fleet-merged SLO burn signal.
+
 ``Router(factory, replicas=N)`` builds N engines up front via the zero-arg
-``factory`` (also used by rolling restarts); ``Router(engines=[...])``
-adopts existing engines but cannot rolling-restart without a factory.
+``factory`` (also used by rolling restarts and scale-out);
+``Router(engines=[...])`` adopts existing engines but cannot
+rolling-restart or scale out without a factory.
 """
 
 from __future__ import annotations
 
 import hashlib
 import itertools
+import math
 from collections import OrderedDict
 import random
 import threading
@@ -142,12 +161,28 @@ def _rendezvous_score(key: bytes, idx: int) -> bytes:
                            digest_size=8).digest()
 
 
+def _weighted_score(key: bytes, idx: int, weight: float) -> float:
+    """Weighted rendezvous score (Mosharaf/HRW with weights): map the
+    8-byte digest to a uniform u in (0, 1) and score ``-weight / ln(u)``.
+    At weight 1.0 the score is strictly monotone in the digest, so the
+    ordering is exactly the classic unweighted rendezvous order; shrinking
+    one replica's weight moves ONLY the keys it owned (each key's other
+    scores are untouched) — the minimal-churn property rebalance relies
+    on. Weights are clamped to a small positive floor: a zero weight
+    would un-rank the replica for every key at once."""
+    digest = _rendezvous_score(key, idx)
+    u = (int.from_bytes(digest, "big") + 1) / (2 ** 64 + 1)
+    return -max(weight, 1e-6) / math.log(u)
+
+
 class _Replica:
     """One engine + its supervisor + routing state. ``routable`` is the
     router-side gate (rolling restart pulls a replica from rotation before
-    the engine itself starts draining)."""
+    the engine itself starts draining); ``weight`` scales its rendezvous
+    scores (1.0 = classic HRW; rebalance sheds by shrinking it)."""
 
-    __slots__ = ("idx", "engine", "supervisor", "routable", "restarts")
+    __slots__ = ("idx", "engine", "supervisor", "routable", "restarts",
+                 "weight")
 
     def __init__(self, idx: int, engine, supervisor):
         self.idx = idx
@@ -155,6 +190,7 @@ class _Replica:
         self.supervisor = supervisor
         self.routable = True
         self.restarts = 0
+        self.weight = 1.0
 
     def state(self) -> str:
         if self.supervisor is not None and self.supervisor.breaker_open:
@@ -222,6 +258,10 @@ class Router:
             engines = [factory() for _ in range(n)]
         self._replicas = [self._adopt(i, eng)
                           for i, eng in enumerate(engines)]
+        # stable replica indices, never reused: a scale-out after a retire
+        # must not resurrect a retired index — rendezvous keys the index,
+        # and reuse would silently inherit the dead replica's affinity
+        self._next_idx = itertools.count(len(self._replicas))
         if warmup:
             for rep in self._replicas:
                 rep.engine.warmup()
@@ -284,8 +324,9 @@ class Router:
         if request is not None and len(ready) >= 2:
             key = _prefix_route_key(request, ready)
             if key is not None and self._prefix_seen(key):
-                return sorted(ready, reverse=True,
-                              key=lambda r: _rendezvous_score(key, r.idx))
+                return sorted(
+                    ready, reverse=True,
+                    key=lambda r: _weighted_score(key, r.idx, r.weight))
         if len(ready) <= 2:
             return sorted(ready, key=lambda r: r.load())
         a, b = self._rng.sample(ready, 2)
@@ -350,13 +391,17 @@ class Router:
                                "with a factory")
         out = {}
         with self._restart_lock:
-            for idx in range(len(self._replicas)):
+            with self._lock:
+                rotation = list(self._replicas)
+            for rep in rotation:
                 t0 = time.monotonic()
                 with self._lock:
                     if self._closed:
                         break  # close() won the race; nothing to rotate
-                    rep = self._replicas[idx]
+                    if rep not in self._replicas:
+                        continue  # retired underneath us (scale-in)
                     rep.routable = False
+                idx = rep.idx
                 self._publish_states()
                 self._emit(ev="replica_rotate", router=self._name,
                            replica=idx, phase="migrate")
@@ -377,14 +422,165 @@ class Router:
                 self._accumulate(rep.engine)
                 fresh = self._factory()
                 with self._lock:
-                    self._replicas[idx] = self._adopt(idx, fresh)
-                    self._replicas[idx].restarts = rep.restarts + 1
+                    pos = self._replicas.index(rep)
+                    newrep = self._adopt(idx, fresh)
+                    newrep.restarts = rep.restarts + 1
+                    newrep.weight = rep.weight
+                    self._replicas[pos] = newrep
                 self._publish_states()
-                self._warm_replica(idx)
+                self._warm_replica(newrep)
                 out[idx] = round(time.monotonic() - t0, 6)
                 self._emit(ev="replica_rotate", router=self._name,
                            replica=idx, phase="done", seconds=out[idx])
         return out
+
+    # ---------------------------------------------------- elastic membership
+
+    def add_replica(self) -> int:
+        """Scale-out: factory-spawn a replica, warm its prefix cache from
+        the warmest ready peer, and join it to the rendezvous ring — the
+        join is one list append under the lock, so a concurrent
+        ``_candidates`` snapshot sees the fleet either before or after,
+        never half-joined. The fresh replica gets a brand-new supervisor
+        (fresh restart-breaker window — it must not inherit a struggling
+        peer's sliding-window history) and a never-before-used index. A
+        spawn that fails or dies before the join is closed and discarded
+        — the ring is untouched, no work existed to lose. Returns the new
+        replica's index. Serialized against rotations/retires."""
+        if self._factory is None:
+            raise RuntimeError("add_replica needs the Router built with "
+                               "a factory")
+        with self._restart_lock:
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("router is closed")
+                idx = next(self._next_idx)
+            faults.fire("serve.fleet", path=f"spawn-{idx}")
+            rep = self._adopt(idx, self._factory())
+            try:
+                self._warm_replica(rep)
+                faults.fire("serve.fleet", path=f"join-{idx}")
+                if not rep.ready():
+                    raise RuntimeError(
+                        f"fresh replica {idx} not accepting "
+                        f"(state {rep.state()}) — refusing to join it")
+                with self._lock:
+                    if self._closed:
+                        raise RuntimeError("router closed during spawn")
+                    self._replicas.append(rep)
+            except BaseException:
+                # orphan cleanup: the spawn never joined, nothing routed
+                # to it, closing it drops no work
+                if rep.supervisor is not None:
+                    rep.supervisor.close()
+                rep.engine.close()
+                raise
+        self._publish_states()
+        self._emit(ev="replica_add", router=self._name, replica=idx,
+                   replicas=self.replica_count())
+        return idx
+
+    def retire_replica(self, idx: int | None = None) -> int:
+        """Scale-in: pull one replica (the least-loaded ready one when
+        ``idx`` is None) out of rotation FIRST — it drops out of every
+        rendezvous score list and readiness snapshot immediately — then
+        migrate its live rows and queued backlog to its peers over the
+        same lossless freeze→adopt path the rolling restart uses (legs
+        that fail degrade to retry twins, never to dropped work), close
+        it, and remove it from the fleet. Refuses to retire the last
+        replica. Returns the retired index. Serialized against
+        rotations/adds."""
+        with self._restart_lock:
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("router is closed")
+                live = list(self._replicas)
+                if len(live) <= 1:
+                    raise RuntimeError("cannot retire the last replica")
+                if idx is None:
+                    ready = [r for r in live if r.ready()]
+                    pool = ready if len(ready) >= 2 else live
+                    rep = min(pool, key=lambda r: r.load())
+                else:
+                    rep = next((r for r in live if r.idx == idx), None)
+                    if rep is None:
+                        raise ValueError(f"no replica with index {idx}")
+                rep.routable = False  # leaves every rendezvous list NOW
+            self._publish_states()
+            try:
+                faults.fire("serve.fleet", path=f"retire-{rep.idx}")
+            except BaseException:
+                with self._lock:
+                    rep.routable = True  # aborted before any state moved
+                self._publish_states()
+                raise
+            self._emit(ev="replica_retire", router=self._name,
+                       replica=rep.idx, phase="migrate")
+            if not self._migrate_out(rep):
+                self._emit(ev="replica_retire", router=self._name,
+                           replica=rep.idx, phase="drain")
+                rep.engine.drain()
+            if rep.supervisor is not None:
+                rep.supervisor.close()
+            rep.engine.close()
+            self._accumulate(rep.engine)
+            with self._lock:
+                if rep in self._replicas:
+                    self._replicas.remove(rep)
+            self._m_replica_state.labels(
+                router=self._name, replica=rep.idx).set(
+                    REPLICA_STATES["closed"])
+        self._publish_states()
+        self._emit(ev="replica_retire", router=self._name, replica=rep.idx,
+                   phase="done", replicas=self.replica_count())
+        return rep.idx
+
+    def shed_weight(self, idx: int | None = None,
+                    frac: float = 0.5) -> tuple[int, float]:
+        """Rebalance: shrink one replica's rendezvous weight by ``frac``
+        (the most-loaded ready replica when ``idx`` is None), re-placing
+        exactly that share of its seen-prefix ownership onto its peers —
+        weighted HRW guarantees no other replica's keys move. In-flight
+        rows stay where they are (re-placement affects new routing only);
+        the weight floor keeps the replica in every score list so it
+        still serves as a failover candidate. Returns (index, new
+        weight)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("router is closed")
+            live = [r for r in self._replicas if r.ready()]
+            if not live:
+                raise RuntimeError("no ready replica to rebalance")
+            if idx is None:
+                rep = max(live, key=lambda r: r.load())
+            else:
+                rep = next((r for r in self._replicas if r.idx == idx),
+                           None)
+                if rep is None:
+                    raise ValueError(f"no replica with index {idx}")
+        faults.fire("serve.fleet", path=f"shed-{rep.idx}")
+        with self._lock:
+            rep.weight = max(0.05, rep.weight * (1.0 - float(frac)))
+            new = rep.weight
+        self._emit(ev="rebalance", router=self._name, replica=rep.idx,
+                   weight=round(new, 4), frac=frac)
+        return rep.idx, new
+
+    def replica_count(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def replica_view(self) -> list[dict]:
+        """Per-replica routing state for the fleet controller and
+        ``GET /debug/fleet``: index, lifecycle state, queue depth,
+        rendezvous weight, restart count. The controller's ONLY source of
+        truth — it keeps no fleet state of its own, so a restarted
+        controller reconstructs everything from this view."""
+        with self._lock:
+            reps = list(self._replicas)
+        return [{"replica": r.idx, "state": r.state(), "load": r.load(),
+                 "weight": round(r.weight, 4), "restarts": r.restarts}
+                for r in reps]
 
     def _migrate_out(self, rep: _Replica) -> bool:
         """Freeze ``rep`` and move everything it holds: live rows adopt
@@ -495,14 +691,15 @@ class Router:
                     reason="no ready replica to migrate to"))
         return placed
 
-    def _warm_replica(self, idx: int) -> None:
-        """Warm a rebuilt replica's prefix cache from the busiest ready
-        peer's hottest chains (``serve_cache_warm_prefixes``). Entirely
+    def _warm_replica(self, fresh: _Replica) -> None:
+        """Warm a rebuilt or freshly spawned replica's prefix cache from
+        the busiest ready peer's hottest chains
+        (``serve_cache_warm_prefixes``). ``fresh`` need not be in the
+        replica list yet — scale-out warms BEFORE the ring join. Entirely
         best-effort: every failure path is a cold cache, never a failed
         rotation."""
         n = get_config().serve_cache_warm_prefixes
         with self._lock:
-            fresh = self._replicas[idx]
             peers = [r for r in self._replicas
                      if r is not fresh and r.ready()
                      and getattr(r.engine, "paged", False)]
@@ -522,7 +719,7 @@ class Router:
                 continue
             if got:
                 self._emit(ev="migrate", router=self._name,
-                           replica=idx, phase="cache_warm",
+                           replica=fresh.idx, phase="cache_warm",
                            source=peer.idx, prefixes=got)
                 return
 

@@ -17,7 +17,10 @@ loop:
   ``watchdog_s`` while work is pending is declared stuck — the engine's
   worker *generation* is superseded (the stale thread exits at its next
   check and can never retire a superseded entry) and a fresh generation
-  takes over.
+  takes over. A ``warmup()`` in progress is exempt (``engine._warming``):
+  first-compile latencies routinely outlast any sane watchdog, and a
+  freshly scaled-out replica must not be "recovered" mid-warmup — crash
+  detection stays on throughout.
 - **Recovery** (``ServeEngine._recover``) rebuilds from the admission
   contract outward: slot pools are dropped (the KV slab state died with
   the worker; pools rebuild zeroed on the next admission — the PR 4
@@ -187,6 +190,7 @@ class Supervisor:
             return not self.breaker_open
         hb = eng._heartbeat
         if (self.watchdog_s > 0 and eng._started and hb is not None
+                and not eng._warming
                 and time.monotonic() - hb > self.watchdog_s
                 and eng._state in ("running", "draining")
                 and eng.pending() > 0):

@@ -101,7 +101,7 @@ KNOWN_POINTS = frozenset({
     "ckpt.write", "ckpt.manifest", "fs.open", "fs.list", "step.run",
     "device.probe", "prefetch.produce", "dataplane.read", "serve.enqueue",
     "serve.step", "serve.prefill", "serve.decode_step", "serve.worker_crash",
-    "serve.router_route", "serve.migrate",
+    "serve.router_route", "serve.migrate", "serve.fleet",
 })
 
 

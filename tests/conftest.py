@@ -58,9 +58,10 @@ def compile_count():
 
 # worker-thread name prefixes owned by the library; each subsystem joins its
 # workers on close (ChunkPrefetcher.close, ServeEngine.drain/close,
-# MetricsServer.close), so any survivor after a test is a leak in that test
-# or that subsystem
-_WORKER_PREFIXES = ("marlin-prefetch", "marlin-serve", "marlin-obs")
+# MetricsServer.close, FleetController.close), so any survivor after a test
+# is a leak in that test or that subsystem
+_WORKER_PREFIXES = ("marlin-prefetch", "marlin-serve", "marlin-obs",
+                    "marlin-fleet")
 
 
 def _worker_threads():

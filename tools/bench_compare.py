@@ -19,6 +19,10 @@ What counts as a regression:
 - a router config whose ``prefix-hit-rate X`` detail fell beyond tolerance
   when both sides carry it — routing that stops landing shared prefixes on
   the warm replica regresses cost per token long before tok/s notices;
+- an elastic-fleet config whose ``replica-hours-saved F`` detail fell
+  beyond tolerance when both sides carry it — the fleet controller's whole
+  point is serving the diurnal trace on fewer replica-hours than the
+  peak-sized static fleet, so the saving is gated higher-is-better;
 - a ``*_FAILED`` error record in NEW with no counterpart in BASE (a config
   that used to run and now crashes is the worst regression of all);
 - a config present in BASE but missing from NEW is *reported* (dropped)
@@ -67,6 +71,7 @@ INFORMATIONAL = {"frac"}
 
 _TTFT_RE = re.compile(r"ttft p50 (\d+(?:\.\d+)?) ms")
 _HIT_RE = re.compile(r"prefix-hit-rate (\d+(?:\.\d+)?)")
+_SAVED_RE = re.compile(r"replica-hours-saved (\d+(?:\.\d+)?)")
 
 #: units a slower *host* explains — eligible for the control-sentinel
 #: downgrade; accuracy ("rel err") is excluded on purpose
@@ -95,6 +100,11 @@ def _hit_rate(rec: dict) -> float | None:
     if isinstance(v, (int, float)):
         return float(v)
     m = _HIT_RE.search(str(rec.get("detail", "")))
+    return float(m.group(1)) if m else None
+
+
+def _hours_saved(rec: dict) -> float | None:
+    m = _SAVED_RE.search(str(rec.get("detail", "")))
     return float(m.group(1)) if m else None
 
 
@@ -199,11 +209,22 @@ def compare(base: dict[str, dict], new: dict[str, dict],
             status = "REGRESSION"
             note = (note + " " if note else "") + \
                 f"prefix-hit-rate {bh:.3f}->{nh:.3f}"
+        # the elastic-fleet leg: higher-better replica-hours saving gated
+        # only when both sides report it (pre-fleet BASE files don't)
+        bsv, nsv = _hours_saved(b), _hours_saved(n)
+        saved_bad = bsv is not None and nsv is not None and bsv > 0 \
+            and nsv < bsv * (1 - tol)
+        if saved_bad:
+            bad = True
+            status = "REGRESSION"
+            note = (note + " " if note else "") + \
+                f"replica-hours-saved {bsv:.3f}->{nsv:.3f}"
         if bad and drift is not None and unit in _HOST_SENSITIVE \
-                and not hit_bad:
+                and not hit_bad and not saved_bad:
             # the control slid with the candidate: machine weather, not a
             # code regression — report loudly, fail nothing (a hit-rate
-            # drop is a routing property and is never weather)
+            # drop is a routing property, a replica-hours saving is a
+            # control property — neither is ever weather)
             status = "WARN(host-drift)"
             note = (note + " " if note else "") + \
                 f"control slid {drift * 100:+.1f}%"

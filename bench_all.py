@@ -1025,6 +1025,111 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
            f"{events_path} (analyze: python -m marlin_tpu.obs.report)")
 
 
+def config_serve_als(d_model=64, heads=4, layers=2, vocab=256):
+    """BucketProgram serving legs (serving/programs/, ISSUE 18): (a) ALS
+    recommendation scoring alone through the engine spine — achieved QPS
+    and p50/p99 submit→Result latency against device-resident factors
+    (`serve_als`) — and (b) the mixed-traffic leg: the same open-loop LM
+    stream run bare, then again with an equal ALS stream interleaved on the
+    SAME engine; `serve_mixed_lm` records the mixed run's LM tokens/s with
+    the LM-only control and the mixed/solo ratio in the detail (acceptance:
+    within 5% — co-resident one-shot programs must not tax LM decode).
+
+    MARLIN_BENCH_SERVE_ALS_N (ALS requests, default 256),
+    MARLIN_BENCH_SERVE_ALS_SHAPE ("users,items,rank", default
+    "512,256,16"), MARLIN_BENCH_SERVE_MIX_N (LM requests per mixed leg,
+    default 32) size the legs; MARLIN_BENCH_REPS medians the mixed pair."""
+    import jax  # noqa: F401  (backend init before threads)
+
+    from marlin_tpu.models import TransformerLM
+    from marlin_tpu.serving import (ALSScoreProgram, Request, ServeEngine,
+                                    percentile)
+
+    n_als = int(os.environ.get("MARLIN_BENCH_SERVE_ALS_N", 256))
+    users, items, rank = (int(v) for v in os.environ.get(
+        "MARLIN_BENCH_SERVE_ALS_SHAPE", "512,256,16").split(","))
+    n_lm = int(os.environ.get("MARLIN_BENCH_SERVE_MIX_N", 32))
+    reps = max(1, int(os.environ.get("MARLIN_BENCH_REPS", "1")))
+    rng = np.random.default_rng(0)
+    uf = rng.standard_normal((users, rank)).astype(np.float32)
+    pf = rng.standard_normal((items, rank)).astype(np.float32)
+    lm = TransformerLM(vocab=vocab, d_model=d_model, heads=heads,
+                      layers=layers, seed=0)
+    params = lm.init_params()
+    buckets = ((64, 32),)
+
+    def make_engine():
+        eng = ServeEngine(params, heads, buckets=buckets, max_batch=8,
+                          max_wait_ms=1.0, queue_depth=4 * (n_als + n_lm),
+                          programs=[ALSScoreProgram((uf, pf))])
+        eng.warmup()
+        return eng
+
+    def als_requests(n):
+        return [Request(program="als",
+                        payload={"user": int(rng.integers(0, users)),
+                                 "k": 8})
+                for _ in range(n)]
+
+    def lm_requests(n):
+        return [Request(prompt=rng.integers(0, vocab, int(
+                    rng.integers(8, 48))).astype(np.int32),
+                        steps=int(rng.integers(4, 16)))
+                for _ in range(n)]
+
+    # ---- leg (a): ALS alone — QPS + latency percentiles
+    eng = make_engine()
+    try:
+        t0 = time.perf_counter()
+        handles = [eng.submit(r) for r in als_requests(n_als)]
+        eng.drain()
+        span = time.perf_counter() - t0
+        results = [h.result(timeout=0) for h in handles]
+    finally:
+        eng.close()
+    ok = [r for r in results if r.ok]
+    lat = sorted(r.metrics["total_s"] for r in ok)
+    ms = lambda q: (f"{percentile(lat, q) * 1e3:.1f}"  # noqa: E731
+                    if lat else "n/a")
+    record("serve_als", len(ok) / span, "req/s",
+           f"{len(ok)}/{n_als} ok; top-8 of {items} items, rank {rank}, "
+           f"{users} users resident; p50 {ms(50)} ms / p99 {ms(99)} ms "
+           f"submit-to-result")
+
+    # ---- leg (b): the mixed-traffic bar — LM tok/s solo vs with an equal
+    # ALS stream co-resident on the same engine
+    def run_lm(mixed):
+        eng = make_engine()
+        try:
+            reqs = lm_requests(n_lm)
+            extra = als_requests(n_lm) if mixed else []
+            t0 = time.perf_counter()
+            handles = [eng.submit(r) for r in reqs]
+            ehandles = [eng.submit(r) for r in extra]
+            eng.drain()
+            span = time.perf_counter() - t0
+            results = [h.result(timeout=0) for h in handles]
+            eok = sum(h.result(timeout=0).ok for h in ehandles)
+        finally:
+            eng.close()
+        toks = sum(r.tokens.size - len(q.prompt)
+                   for q, r in zip(reqs, results) if r.ok)
+        return toks / span, sum(r.ok for r in results), eok
+
+    solo = sorted(run_lm(False)[0] for _ in range(reps))[reps // 2]
+    mixed_runs = sorted((run_lm(True) for _ in range(reps)),
+                        key=lambda t: t[0])
+    mixed_toks, lm_ok, als_ok = mixed_runs[reps // 2]
+    ratio = mixed_toks / solo if solo else 0.0
+    record("serve_mixed_lm", mixed_toks, "tok/s",
+           f"LM decode under mixed LM+ALS load: {lm_ok}/{n_lm} LM ok with "
+           f"{als_ok}/{n_lm} ALS ok co-resident; LM-only control "
+           f"{solo:.1f} tok/s, mixed/solo ratio {ratio:.3f} "
+           f"(bar: >= 0.95)" + (f"; median of {reps} reps"
+                                if reps > 1 else ""),
+           extra={"mixed_solo_ratio": round(ratio, 4)})
+
+
 def config_serve_slo(d_model=64, heads=4, layers=2, vocab=256):
     """SLO-engine acceptance leg (docs/observability.md "Serving SLOs"):
     the same open-loop serve run twice — leg A with `serve_slo` objectives
@@ -1449,6 +1554,7 @@ def main():
         "decode": config_decode,
         "moe": config_moe,
         "serve": config_serve,
+        "serve_als": config_serve_als,
         "serve_slo": config_serve_slo,
         "fleet": config_fleet,
     }

@@ -116,12 +116,15 @@ class MarlinConfig:
     # one compile per sampling variant; prompts/steps round UP to the
     # smallest fitting bucket (docs/serving.md has tuning guidance).
     serve_buckets: tuple = ((64, 32), (256, 64))
-    # DEPRECATED (PR 8): the gang scheduler this knob used to fall back to
-    # is retired — the engine always schedules row-level (paged by default,
-    # dense-slab with serve_paged=False). Parsing is kept so old configs
-    # don't hard-fail; setting it False earns a DeprecationWarning from the
-    # engine and changes nothing.
-    serve_rowlevel: bool = True
+    # Padded batch widths for non-LM BucketPrograms (serving/programs/): a
+    # one-shot program batch pads up to the smallest width that fits, so
+    # compiles per program are bounded by this set x its bucket set. Sorted
+    # and deduplicated at program construction.
+    serve_program_batches: tuple = (8, 32)
+    # Static top-k depths ALS and PageRank queries compile for; a request's
+    # k rounds UP to the smallest fitting depth (results slice back down).
+    # Depths beyond the resident model's item/node count are dropped.
+    serve_program_topk: tuple = (8,)
     # Paged KV cache (default): the engine owns ONE device-resident page
     # slab (serve_num_pages x serve_page_len KV rows per layer) shared by
     # every bucket, rows hold block tables of pages, admission charges the

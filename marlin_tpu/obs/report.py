@@ -63,7 +63,7 @@ KNOWN_KINDS = frozenset({
 KNOWN_SERVE_EVS = frozenset({
     "breaker", "enqueue", "migrate", "page", "prefill", "rebalance",
     "reject", "replica_add", "replica_retire", "replica_rotate", "restart",
-    "result", "retry", "route_failover", "step",
+    "result", "retry", "route_failover", "step", "swap",
 })
 
 
@@ -205,6 +205,38 @@ def _serving_section(events: list[dict]) -> list[str]:
     submitted = sum(1 for r in serve if r.get("ev") == "enqueue")
     status_str = ", ".join(f"{k} {v}" for k, v in sorted(by_status.items()))
     out.append(f"requests: submitted {submitted}; results: {status_str}")
+    # per-program ride-along (only when the stream carries program-labelled
+    # serve records — serving/programs/ BucketPrograms — so pure-LM logs
+    # render unchanged): terminal outcomes, completed-result p50 latency,
+    # and hot model swaps per serving program. Records with no program
+    # field are LM's (its events stay byte-identical to pre-program logs).
+    if any("program" in r for r in serve
+           if r.get("ev") in ("enqueue", "result", "step", "swap")):
+        by_prog: dict[str, dict] = {}
+        for r in results:
+            p = r.get("program", "lm")
+            d = by_prog.setdefault(p, {"status": {}, "total": []})
+            d["status"][r.get("status", "?")] = \
+                d["status"].get(r.get("status", "?"), 0) + 1
+            if r.get("status") == "ok" and \
+                    isinstance(r.get("total_s"), (int, float)):
+                d["total"].append(r["total_s"])
+        swaps: dict[str, int] = {}
+        for r in serve:
+            if r.get("ev") == "swap":
+                p = r.get("program", "?")
+                swaps[p] = swaps.get(p, 0) + 1
+                by_prog.setdefault(p, {"status": {}, "total": []})
+        out.append("per-program results:")
+        out.append(f"  {'program':<12}{'results':>8}{'ok':>6}{'other':>7}"
+                   f"{'p50 ms':>9}{'swaps':>7}")
+        for p in sorted(by_prog):
+            d = by_prog[p]
+            n = sum(d["status"].values())
+            n_ok = d["status"].get("ok", 0)
+            p50 = (_ms(percentile(d["total"], 50)) if d["total"] else "-")
+            out.append(f"  {p:<12}{n:>8}{n_ok:>6}{n - n_ok:>7}"
+                       f"{p50:>9}{swaps.get(p, 0):>7}")
     # resilience ride-along (only when the stream carries it, so logs from
     # pre-retry engines render unchanged): transparent re-queues, worker
     # restarts, breaker transitions. A retried request's queue/ttft/total

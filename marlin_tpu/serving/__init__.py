@@ -13,6 +13,13 @@ multi-replica router with failover, drain-safe rolling restarts, and
 elastic membership (router.py), driven by the SLO-burn fleet controller
 (fleet.py — docs/robustness.md covers the resilience layer).
 
+The spine is workload-pluggable (programs/): ``Request.program`` routes a
+request to a registered :class:`~.programs.BucketProgram` — paged LM
+decode is the first implementation, and ALS recommendation scoring,
+incremental PageRank queries, and batched classification ship alongside
+it, all sharing the same admission budget, bucketing, supervisor, and
+router (docs/serving.md, "BucketProgram interface").
+
 Quick start::
 
     from marlin_tpu.serving import Request, ServeEngine
@@ -30,6 +37,7 @@ from .batcher import (  # noqa: F401
     bucket_kv_bytes,
     normalize_buckets,
     pick_bucket,
+    planner_ratio_warning,
     warmup_buckets,
 )
 from .engine import ServeEngine  # noqa: F401
@@ -41,6 +49,17 @@ from .kvpool import (  # noqa: F401
 )
 from .fleet import FleetController  # noqa: F401
 from .metrics import ServeMetrics, percentile  # noqa: F401
+from .programs import (  # noqa: F401
+    PROGRAM_REGISTRY,
+    ALSScoreProgram,
+    BucketProgram,
+    ClassifyProgram,
+    PagedLMProgram,
+    PageRankQueryProgram,
+    ProgramRowSet,
+    available_programs,
+    register_program,
+)
 from .router import Router  # noqa: F401
 from .supervisor import Supervisor  # noqa: F401
 from .request import (  # noqa: F401

@@ -280,7 +280,6 @@ def bucket_program_key(params: dict, bucket: Bucket, max_batch: int,
 def capture_bucket_costs(params: dict, heads: int, bucket: Bucket,
                          max_batch: int, compute_dtype: str | None = None,
                          moe: tuple | None = None,
-                         rowlevel: bool | None = None,
                          key: str | None = None) -> None:
     """Capture the XLA cost model (flops, bytes accessed) of a bucket's
     slab program pair into the process :class:`~marlin_tpu.obs.perf
@@ -291,15 +290,12 @@ def capture_bucket_costs(params: dict, heads: int, bucket: Bucket,
     first. Callers on the dispatch path pass their cached ``key`` (the
     engine's ``_prog_key``) so the gate really is that cheap — rebuilding
     it walks the params tree. Never raises: cost capture is observability
-    and must not fail warmup or a dispatch. ``rowlevel`` is vestigial
-    (accepted, ignored — the gang program this captured when False is
-    retired); the paged pair captures through
+    and must not fail warmup or a dispatch. The paged pair captures through
     :func:`~.kvpool.capture_paged_costs`."""
     import jax
 
     from ..obs import perf
 
-    del rowlevel  # retired with the gang scheduler (PR 8)
     costs = perf.get_program_costs()
     if key is None:
         key = bucket_program_key(params, bucket, max_batch, compute_dtype)
@@ -346,21 +342,18 @@ def capture_bucket_costs(params: dict, heads: int, bucket: Bucket,
 
 def warmup_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
                    max_batch: int, compute_dtype: str | None = None,
-                   moe: tuple | None = None,
-                   rowlevel: bool | None = None) -> int:
+                   moe: tuple | None = None) -> int:
     """Compile (and execute once, on dummy rows) every bucket's dense-slab
     program pair — slot-targeted prefill and the single-token decode step
     over a throwaway slab — so the first real request never pays the
     compile. Sampling knobs are per-row traced, so the two programs are
     the whole slab compile story (docs/serving.md); paged engines warm
     through :func:`~.kvpool.warmup_paged` against their live pool instead.
-    ``rowlevel`` is vestigial (accepted, ignored — the gang program it
-    used to warm when False is retired). Returns the buckets warmed."""
+    Returns the buckets warmed."""
     import jax
 
     from ..models.transformer import lm_decode_rows, lm_prefill_slot
 
-    del rowlevel  # retired with the gang scheduler (PR 8)
     buckets = normalize_buckets(buckets)
     for bucket in buckets:
         p, s = bucket
@@ -395,11 +388,33 @@ def _peak_bytes(ma) -> int:
                + ma.output_size_in_bytes)
 
 
+def planner_ratio_warning(bucket: Bucket, peak_bytes: int,
+                          planner_bytes: int,
+                          factor: float = 2.0) -> str | None:
+    """Planner honesty check: the warning text when the compiler's own peak
+    accounting for a bucket exceeds the planner's slab arithmetic
+    (``bucket_kv_bytes`` at full batch) by more than ``factor``, else
+    ``None``. Pure so tests pin the threshold without a TPU: a ratio this
+    far above 1.0 means the planner's admission budget is not the number
+    HBM will actually see, and ``serve_max_batch`` sized from it will OOM
+    under load."""
+    if planner_bytes <= 0:
+        return None
+    ratio = peak_bytes / planner_bytes
+    if ratio <= factor:
+        return None
+    return (f"bucket {bucket}: compiler peak {peak_bytes} B is "
+            f"{ratio:.1f}x the planner's {planner_bytes} B slab "
+            f"arithmetic — size serve_buckets/serve_max_batch from the "
+            f"measured peak, not the planner (docs/serving.md, bucket "
+            f"tuning)")
+
+
 def aot_compile_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
                         max_batch: int, compute_dtype: str | None = None,
                         moe: tuple | None = None,
-                        topology_name: str = "v5e:2x2",
-                        rowlevel: bool | None = None) -> dict[Bucket, int]:
+                        topology_name: str = "v5e:2x2"
+                        ) -> dict[Bucket, int]:
     """Compile every bucket's program(s) against a compile-only TPU
     topology (no chip; :mod:`marlin_tpu.utils.aot`) and return
     ``{bucket: peak_hbm_bytes}`` from the compiler's own accounting — the
@@ -407,8 +422,10 @@ def aot_compile_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
     against :func:`~marlin_tpu.models.planner.usable_hbm_bytes` (the same
     budget the admission gate enforces at runtime). Compiles the dense-slab
     backend's program pair (slot prefill + decode step) and reports the
-    larger peak; ``rowlevel`` is vestigial (accepted, ignored — the gang
-    program is retired). Sizing rule: every bucket's persistent slab stays
+    larger peak, warning (``RuntimeWarning``) when that peak exceeds the
+    planner's slab arithmetic by more than 2x
+    (:func:`planner_ratio_warning`). Sizing rule: every bucket's persistent
+    slab stays
     device-resident simultaneously (the engine never frees a pool), so
     steady-state HBM is the SUM over buckets of ``bucket_kv_bytes(...,
     batch=max_batch)`` plus the largest per-bucket program peak reported
@@ -429,7 +446,6 @@ def aot_compile_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
                                       _lm_prefill_slot_jit, init_kv_slab)
     from ..utils.aot import topology_mesh
 
-    del rowlevel  # retired with the gang scheduler (PR 8)
     mesh = topology_mesh(("rows",), (1,), topology_name=topology_name)
     rep = NamedSharding(mesh, PartitionSpec())
 
@@ -476,4 +492,11 @@ def aot_compile_buckets(params: dict, heads: int, buckets: Sequence[Bucket],
             costs.capture("lm_decode_rows", prog_key, compiled=dec)
             out[bucket] = max(_peak_bytes(pre.memory_analysis()),
                               _peak_bytes(dec.memory_analysis()))
+            msg = planner_ratio_warning(
+                bucket, out[bucket],
+                bucket_kv_bytes(params, heads, bucket, compute_dtype))
+            if msg is not None:
+                import warnings
+
+                warnings.warn(msg, RuntimeWarning, stacklevel=2)
     return out

@@ -6,9 +6,9 @@ an admission gate (queue depth + in-flight KV-cache HBM budget, request.py)
 rejects overload with a reason; a batch former (batcher.py) buckets prompts
 onto a small static shape set so compiles stay bounded; and a single worker
 thread keeps the device fed. Scheduling is row-level (the unit is the
-slot-step, not the batch — the gang scheduler of PR 3 is retired; a
-``serve_rowlevel=False`` config earns a DeprecationWarning and changes
-nothing). Two KV-cache backends share the row-level skeleton:
+slot-step, not the batch — the gang scheduler of PR 3 is retired and its
+``rowlevel`` escape hatch removed). Two KV-cache backends share the
+row-level skeleton:
 
 **Paged** (``serve_paged``, the default): ONE device-resident page slab
 (:mod:`.kvpool` over :func:`~marlin_tpu.models.transformer.init_kv_pages`)
@@ -49,6 +49,19 @@ per request, per-row greedy output bit-identical to
 (the paged decode literally reuses ``_decode_step``), and sampled rows on
 composition-independent ``fold_in(key(seed), step)`` streams.
 
+**Pluggable programs** (serving/programs/): LM decode is one
+:class:`~.programs.BucketProgram` among several — ``ServeEngine(...,
+programs=[ALSScoreProgram(model), ...])`` registers additional request
+types (``Request.program``) that ride the SAME spine: admission prices
+each program in its own resource-unit bytes against the one HBM budget,
+the former buckets program requests under ``(name, *bucket)`` keys next to
+LM's ``(prompt, steps)`` tuples, and the worker loop interleaves one-shot
+program batches (:class:`~.programs.ProgramRowSet` rows, a single compiled
+step per bucket) between LM prefill chunks and decode steps. Every
+program's rows are drained, closed, crash-recovered, frozen and adopted by
+the same code paths as LM rows — a program row just has no KV pages to
+carry, so migration moves it through the queued/fallback lanes.
+
 Lifecycle: ``drain()`` stops admission and completes everything already
 accepted; ``close()`` stops admission, finishes the work in flight (live
 and mid-prefill rows), and retires everything still queued with a clean
@@ -74,7 +87,6 @@ import collections
 import itertools
 import threading
 import time
-import warnings
 import weakref
 
 import numpy as np
@@ -90,13 +102,13 @@ from ..obs.exposition import (register_health_provider,
                               unregister_slo_provider)
 from ..obs.metrics import get_registry
 from ..utils import faults
-from .batcher import (BatchFormer, bucket_kv_bytes, bucket_program_key,
-                      capture_bucket_costs, normalize_buckets, pick_bucket,
-                      warmup_buckets)
+from .batcher import (BatchFormer, bucket_program_key, capture_bucket_costs,
+                      normalize_buckets, warmup_buckets)
 from .kvpool import (PagedGroup, PagedKVPool, PagePoolExhausted,
                      auto_num_pages, capture_paged_costs, paged_program_key,
                      warmup_paged)
 from .metrics import ServeMetrics
+from .programs import PagedLMProgram, ProgramRowSet
 from .request import (SHED_REASON_PREFIX, STATUS_ERROR, STATUS_EXPIRED,
                       STATUS_OK, STATUS_REJECTED, STATUS_SHUTTING_DOWN,
                       AdmissionQueue, Request, Result, ResultHandle)
@@ -179,9 +191,14 @@ class ServeEngine:
     paged pool (block tables over one shared page slab, prefix caching,
     chunked prefill; ``page_len``/``num_pages``/``prefill_chunk``/
     ``prefix_cache`` override the ``serve_*`` knobs); False = the dense
-    per-bucket slot slab (the PR 4 control). ``rowlevel`` is DEPRECATED:
-    the gang scheduler it used to disable is retired — passing False (or
-    configuring ``serve_rowlevel=False``) warns and changes nothing.
+    per-bucket slot slab (the PR 4 control). The long-deprecated
+    ``rowlevel`` kwarg is REMOVED (the gang scheduler it disabled retired
+    in PR 8) — passing it raises; use ``serve_paged``/``paged`` to pick
+    the KV backend.
+
+    ``programs`` registers additional :class:`~.programs.BucketProgram`
+    instances (ALS scoring, PageRank queries, classification, ...) served
+    next to LM traffic — requests route by ``Request.program``.
 
     Usable as a context manager (``close()`` on exit); ``start=False`` defers
     the worker thread so tests can stage a queue before any dispatch."""
@@ -197,18 +214,18 @@ class ServeEngine:
                  prefill_chunk: int | None = None,
                  prefix_cache: bool | None = None,
                  decode_kernel: str | None = None,
+                 programs=None,
                  clock=time.monotonic, log=None, start: bool = True):
         cfg = get_config()
         self.params = params
         self.heads = heads
         self.compute_dtype = compute_dtype
         self.moe = moe
-        if not (cfg.serve_rowlevel if rowlevel is None else rowlevel):
-            warnings.warn(
-                "serve_rowlevel=False selected the gang scheduler, which "
-                "is retired (PR 8: paging supersedes it) — the engine "
-                "always schedules row-level; use serve_paged/paged to pick "
-                "the KV backend", DeprecationWarning, stacklevel=2)
+        if rowlevel is not None:
+            raise ValueError(
+                "ServeEngine(rowlevel=...) was removed: the gang scheduler "
+                "it selected retired in PR 8 and scheduling is always "
+                "row-level — use serve_paged/paged to pick the KV backend")
         self.rowlevel = True  # legacy attribute: always row-level now
         self.paged = bool(cfg.serve_paged if paged is None else paged)
         self.buckets = normalize_buckets(
@@ -261,6 +278,18 @@ class ServeEngine:
         self._cond = threading.Condition()
         self._former = BatchFormer(self.buckets, self.max_batch,
                                    max_wait=float(wait_ms) / 1e3)
+        # Request.program routing table: LM (this engine's paged/slab path,
+        # wrapped as the first BucketProgram) plus whatever the caller
+        # registered. Former/pool keys for non-LM buckets are namespaced
+        # (name, *bucket) tuples — a str head can never collide with LM's
+        # (prompt, steps) int pairs
+        self._programs: dict[str, object] = {"lm": PagedLMProgram(self)}
+        for p in (programs or ()):
+            if not getattr(p, "name", ""):
+                raise ValueError(f"program {p!r} must set a non-empty .name")
+            if p.name in self._programs:
+                raise ValueError(f"duplicate program name {p.name!r}")
+            self._programs[p.name] = p
         # running | draining | freezing | frozen | closing | closed —
         # freezing/frozen are the migration pause (freeze_rows): the worker
         # parks leaving its pools intact and the freezing thread takes over
@@ -415,15 +444,39 @@ class ServeEngine:
             if self.paged:
                 with self._cond:  # never race a worker's lazy pool creation
                     pool = self._ensure_kvpool()
-                return warmup_paged(self.params, self.heads, self.buckets,
-                                    self.max_batch, pool,
-                                    self._prefill_chunk, self.compute_dtype,
-                                    self.moe, kernel=self._decode_kernel)
-            return warmup_buckets(self.params, self.heads, self.buckets,
-                                  self.max_batch, self.compute_dtype,
-                                  self.moe, rowlevel=True)
+                n = warmup_paged(self.params, self.heads, self.buckets,
+                                 self.max_batch, pool,
+                                 self._prefill_chunk, self.compute_dtype,
+                                 self.moe, kernel=self._decode_kernel)
+            else:
+                n = warmup_buckets(self.params, self.heads, self.buckets,
+                                   self.max_batch, self.compute_dtype,
+                                   self.moe)
+            for name, prog in self._programs.items():
+                if name != "lm":  # LM compiled above against the live pool
+                    n += prog.warmup()
+            return n
         finally:
             self._warming = False
+
+    def swap_model(self, program: str, model) -> None:
+        """Atomically install new weights on a resident BucketProgram (the
+        hot-update seam: same shapes keep the compiled programs serving —
+        the swap is an operand change, never a recompile). Raises for an
+        unknown program or one without a ``swap_model`` hook; on success
+        records one ``ev="swap"`` event +
+        ``marlin_serve_program_swaps_total{program}``."""
+        prog = self._programs.get(program)
+        if prog is None:
+            raise ValueError(
+                f"unknown program {program!r} (this engine serves "
+                f"{sorted(self._programs)})")
+        hook = getattr(prog, "swap_model", None)
+        if hook is None:
+            raise ValueError(
+                f"program {program!r} has no swap_model hook")
+        hook(model)
+        self.metrics.record_swap(program)
 
     def pending(self) -> int:
         """Requests admitted but not yet retired (queued + in flight)."""
@@ -524,8 +577,11 @@ class ServeEngine:
         self._finalized = True
         self._flight_dump("close")
         try:
-            for prog in ("lm_decode_paged", "lm_prefill_paged",
-                         "lm_decode_rows", "lm_prefill_slot"):
+            families = ["lm_decode_paged", "lm_prefill_paged",
+                        "lm_decode_rows", "lm_prefill_slot"]
+            families += [p.cost_program for n, p in self._programs.items()
+                         if n != "lm" and p.cost_program]
+            for prog in dict.fromkeys(families):
                 perf.get_program_costs().emit(prog)
         except Exception:
             pass
@@ -673,12 +729,23 @@ class ServeEngine:
         faults.fire("serve.enqueue", path=str(request.rid))
         handle = ResultHandle(request)
         now = self._clock()
-        bucket = pick_bucket(request.prompt.shape[0], request.steps,
-                             self.buckets)
-        if bucket is None:
+        prog = self._programs.get(request.program)
+        if prog is None:
             return self._refuse(handle, STATUS_REJECTED, (
-                f"no bucket fits prompt_len={request.prompt.shape[0]} "
-                f"steps={request.steps} (buckets {list(self.buckets)})"))
+                f"unknown program {request.program!r} (this engine serves "
+                f"{sorted(self._programs)})"))
+        why = prog.validate(request)
+        if why is not None:
+            return self._refuse(handle, STATUS_REJECTED, why)
+        pbucket = prog.pick_bucket(request)
+        if pbucket is None:
+            return self._refuse(handle, STATUS_REJECTED,
+                                prog.refuse_no_bucket(request))
+        # former/pool key: LM keeps its bare (prompt, steps) tuple (the
+        # pre-refactor keys — events, pools, and migration manifests are
+        # unchanged); other programs namespace theirs under their name
+        bucket = (pbucket if request.program == "lm"
+                  else (prog.name,) + tuple(pbucket))
         # resolve the relative/default deadline to an absolute engine-clock
         # one, ONCE — a router failover or worker restart must not hand the
         # request a fresh budget
@@ -706,23 +773,14 @@ class ServeEngine:
                     f" > deadline {request.deadline:.3f} at queue depth "
                     f"{self._queue.count} (service est "
                     f"{self._service_ewma:.3f}s)"))
-        if self.paged:
-            # admission charges the request's ACTUAL pages (the memory its
-            # cache rows can ever write — planner.request_pages), not the
-            # bucket worst case: short requests in long buckets stop
-            # reserving capacity they never use
-            from ..models.planner import request_pages
-
-            pages = request_pages(request.prompt.shape[0], request.steps,
-                                  self._page_len)
-            if pages > self._num_pages - 1:
-                return self._refuse(handle, STATUS_REJECTED, (
-                    f"request needs {pages} KV pages but the pool holds "
-                    f"{self._num_pages - 1} (serve_num_pages)"))
-            cost = pages * self._page_bytes
-        else:
-            cost = bucket_kv_bytes(self.params, self.heads, bucket,
-                                   self.compute_dtype)
+        # the program prices its own resource units (LM: actual KV pages or
+        # the slab worst case; one-shot programs: their padded device row)
+        # against the one shared HBM admission budget; a capacity refusal
+        # (e.g. more pages than the pool holds) raises the reason
+        try:
+            cost = prog.admission_cost(request, pbucket)
+        except ValueError as exc:
+            return self._refuse(handle, STATUS_REJECTED, str(exc))
         reason = self._queue.try_admit(
             cost, priority=request.priority,
             deadline_slack_s=(request.deadline - now
@@ -759,7 +817,8 @@ class ServeEngine:
             self._queue.release(cost)
             return self._refuse(handle, STATUS_SHUTTING_DOWN,
                                 "engine is shutting down")
-        self.metrics.record_enqueue(request.rid, bucket, self._queue.count)
+        self.metrics.record_enqueue(request.rid, bucket, self._queue.count,
+                                    program=request.program)
         self.metrics.record_queue(self._queue.count,
                                   self._queue.bytes_in_flight)
         return handle
@@ -770,9 +829,11 @@ class ServeEngine:
     def _refuse(self, handle, status: str, reason: str) -> ResultHandle:
         handle._set(Result(handle.request.rid, status, reason=reason))
         if status == STATUS_REJECTED:
-            self.metrics.record_reject(handle.request.rid, reason)
+            self.metrics.record_reject(handle.request.rid, reason,
+                                       program=handle.request.program)
         else:
-            self.metrics.record_result(handle.request.rid, status)
+            self.metrics.record_result(handle.request.rid, status,
+                                       program=handle.request.program)
         return handle
 
     # ----------------------------------------------------------- worker loop
@@ -876,7 +937,8 @@ class ServeEngine:
                 ttft_s=result.metrics.get("ttft_s"),
                 attempt=entry.attempt,
                 pages=result.metrics.get("pages"),
-                shared_pages=result.metrics.get("shared_pages"))
+                shared_pages=result.metrics.get("shared_pages"),
+                program=entry.request.program)
         self.metrics.record_queue(self._queue.count,
                                   self._queue.bytes_in_flight)
 
@@ -940,12 +1002,18 @@ class ServeEngine:
                         if self._gen == gen:
                             self._heartbeat = time.monotonic()
                     self._claimed = claimed
-                self._admit_rowlevel(pools, claimed)
+                prog_claimed = [e for e in claimed
+                                if self._is_program_bucket(e.bucket)]
+                lm_claimed = [e for e in claimed
+                              if not self._is_program_bucket(e.bucket)]
+                self._admit_rowlevel(pools, lm_claimed)
+                self._admit_program_rows(pools, prog_claimed)
                 claimed = []
                 with self._cond:
                     if self._gen == gen:  # never clobber a successor's
                         self._claimed = []  # claimed mirror
                 self._step_rowlevel(pools)
+                self._step_program_rows(pools)
         except BaseException as exc:  # worker death: recover or fail held
             live = [p.entries[i] for p in pools.values()
                     for i in p.live_slots()]
@@ -953,17 +1021,159 @@ class ServeEngine:
                 return
             raise
 
+    @staticmethod
+    def _is_program_bucket(bucket) -> bool:
+        """True for a namespaced (name, *bucket) program key — the one
+        type test that routes a former bucket to the program lane (LM
+        buckets are bare (prompt, steps) int tuples)."""
+        return (isinstance(bucket, tuple) and bool(bucket)
+                and isinstance(bucket[0], str))
+
     def _claim_rowlevel(self, pools) -> list[_Entry]:
         """Claim queued entries for free slots, per bucket (called under the
-        engine lock; prefill happens outside it)."""
+        engine lock; prefill happens outside it). Program buckets claim up
+        to their program's padded width instead of the LM max_batch."""
         claimed = []
         for bucket in self._former.pending_buckets():
             pool = pools.get(bucket)
-            free = self.max_batch if pool is None \
-                else len(pool.free_slots())
+            if pool is not None:
+                free = len(pool.free_slots())
+            elif self._is_program_bucket(bucket):
+                prog = self._programs.get(bucket[0])
+                # an unregistered program's entries (a misrouted adopt)
+                # still claim: _admit_program_rows retires them cleanly
+                free = prog.width if prog is not None else self.max_batch
+            else:
+                free = self.max_batch
             if free:
                 claimed.extend(self._former.take_for_bucket(bucket, free))
         return claimed
+
+    def _admit_program_rows(self, pools, claimed) -> None:
+        """Bind claimed program entries to :class:`ProgramRowSet` slots —
+        host-side only; a one-shot program's device work happens in
+        :meth:`_step_program_rows`. Dispatch order matches the paged
+        admit: priority first, then arrival."""
+        if not claimed:
+            return
+        claimed = sorted(claimed,
+                         key=lambda e: (-e.request.priority, e.request.rid))
+        for e in claimed:
+            with obs_trace.use(e.trace):
+                now = self._clock()
+                r = e.request
+                prog = self._programs.get(e.bucket[0])
+                if prog is None:
+                    # a misrouted adopt: the target fleet lacks this
+                    # program — resolve, never strand
+                    self._retire(e, Result(
+                        r.rid, STATUS_ERROR,
+                        reason=f"program {e.bucket[0]!r} is not registered "
+                               f"on this engine",
+                        metrics={"bucket": e.bucket,
+                                 "queue_s": now - e.enq_t,
+                                 "total_s": now - e.enq_t}))
+                    continue
+                if r.deadline is not None and r.deadline <= now:
+                    self._retire(e, Result(
+                        r.rid, STATUS_EXPIRED,
+                        reason=f"deadline {r.deadline} passed before "
+                               f"dispatch (dispatched at {now})",
+                        metrics={"bucket": e.bucket,
+                                 "queue_s": now - e.enq_t,
+                                 "total_s": now - e.enq_t}))
+                    continue
+                e.queue_s = now - e.enq_t
+                rows = pools.get(e.bucket)
+                if rows is None:
+                    rows = pools[e.bucket] = ProgramRowSet(e.bucket,
+                                                           prog.width)
+                rows.assign(rows.free_slots()[0], e)
+        self._live_rows = sum(len(g.live_slots()) for g in pools.values())
+
+    def _step_program_rows(self, pools) -> None:
+        """One batched compiled call per program bucket with live rows:
+        expire stale rows, pad the rest to the program's smallest fitting
+        width, execute, retire everything with its value — the one-shot
+        analog of a decode step, interleaved with LM prefill chunks and
+        decode steps in the same worker iteration."""
+        for bucket, rows in list(pools.items()):
+            if not isinstance(rows, ProgramRowSet):
+                continue
+            prog = self._programs[bucket[0]]
+            now = self._clock()
+            for i in rows.occupied_slots():
+                dl = rows.entries[i].request.deadline
+                if dl is not None and dl <= now:
+                    self._retire_program_row(
+                        rows, i, STATUS_EXPIRED, now,
+                        reason=f"deadline {dl} passed before the program "
+                               f"step (now {now})")
+            live = rows.occupied_slots()
+            if not live:
+                continue
+            entries = [rows.entries[i] for i in live]
+            pkey = prog.program_key(bucket[1:],
+                                    prog.step_width(len(entries)))
+            try:
+                faults.fire("serve.program_step",
+                            path=f"{bucket[0]}-{len(entries)}")
+                t0 = time.perf_counter()
+                values = prog.step(bucket[1:],
+                                   [e.request for e in entries])
+            except Exception as exc:
+                self._fail_program_rows(rows, exc)
+                continue
+            wall = time.perf_counter() - t0
+            self.metrics.record_step(
+                bucket, len(live), rows.width, wall, program_key=pkey,
+                program=prog.cost_program, label=bucket[0])
+            self.flight.record(
+                "step", bucket=list(bucket), rows=len(live), seconds=wall,
+                queue_depth=self._queue.count, compiles=_compile_count())
+            now = self._clock()
+            for i, val in zip(live, values):
+                self._retire_program_row(rows, i, STATUS_OK, now, value=val)
+        self._live_rows = sum(len(g.live_slots()) for g in pools.values())
+
+    def _retire_program_row(self, rows, slot: int, status: str, now: float,
+                            value=None, reason: str = "") -> None:
+        """Retire one program row and free its slot — the only path a
+        program row leaves its rowset by (the exactly-once release runs in
+        :meth:`_retire` as for every other row). A one-shot answer IS the
+        first output, so ``ttft_s`` equals ``total_s``."""
+        e = rows.entries[slot]
+        metrics = {"bucket": rows.bucket, "slot": slot, "queue_s": e.queue_s,
+                   "ttft_s": now - e.enq_t, "total_s": now - e.enq_t}
+        if status == STATUS_OK:
+            result = Result(e.request.rid, STATUS_OK, value=value,
+                            metrics=metrics)
+        else:
+            result = Result(e.request.rid, status, reason=reason,
+                            metrics=metrics)
+        rows.release(slot)
+        self._retire(e, result)
+
+    def _fail_program_rows(self, rows, exc: Exception) -> None:
+        """A program step died: rows with attempt budget left requeue for
+        a transparent retry; the rest fail with error Results — only this
+        bucket's rows are touched (a program step holds no donated slab,
+        so there is nothing to escalate)."""
+        reason = f"program step failed: {type(exc).__name__}: {exc}"
+        self.flight.record("program_fault", bucket=list(rows.bucket),
+                           rows=len(rows.occupied_slots()), error=reason,
+                           queue_depth=self._queue.count,
+                           compiles=_compile_count())
+        now = self._clock()
+        for i in rows.occupied_slots():
+            e = rows.entries[i]
+            if e.attempts_left():
+                rows.release(i)
+                self._requeue(e, reason)
+            else:
+                self._retire_program_row(rows, i, STATUS_ERROR, now,
+                                         reason=reason)
+        self._flight_dump("program-step-failed")
 
     def _admit_rowlevel(self, pools, claimed) -> None:
         """Prefill each claimed entry into a free slot of its bucket's pool
@@ -1002,7 +1212,7 @@ class ServeEngine:
                         capture_bucket_costs(
                             self.params, self.heads, e.bucket,
                             self.max_batch, self.compute_dtype, self.moe,
-                            rowlevel=True, key=self._prog_key(e.bucket))
+                            key=self._prog_key(e.bucket))
                     slot = pool.free_slots()[0]
                     prompt = np.zeros((p,), np.int32)
                     n = r.prompt.shape[0]
@@ -1043,6 +1253,8 @@ class ServeEngine:
 
         launched = []
         for bucket, pool in list(pools.items()):
+            if isinstance(pool, ProgramRowSet):
+                continue  # the program lane steps in _step_program_rows
             now = self._clock()
             for i in pool.live_slots():
                 dl = pool.entries[i].request.deadline
@@ -1369,6 +1581,20 @@ class ServeEngine:
                     fallback.append(e)
         else:
             for bucket, group in pools.items():
+                if isinstance(group, ProgramRowSet):
+                    # one-shot program rows have no KV state to export: the
+                    # program's freeze hook may veto, otherwise they ride
+                    # the fallback lane and re-execute on the target
+                    # (exactly-once is the handle's, not the row's)
+                    prog = self._programs.get(bucket[0])
+                    for slot in group.occupied_slots():
+                        e = group.entries[slot]
+                        if not _viable(e):
+                            continue
+                        if prog is not None:
+                            prog.freeze(e)
+                        fallback.append(e)
+                    continue
                 for slot in group.occupied_slots():
                     e = group.entries[slot]
                     if not _viable(e):
@@ -1688,7 +1914,8 @@ class ServeEngine:
             return {"ok": True, "errors": [], "note": "engine is not paged"}
         with self._cond:
             pool = self._kvpool
-            groups = list(self._pools.values())
+            groups = [g for g in self._pools.values()
+                      if not isinstance(g, ProgramRowSet)]
         if pool is None:
             return {"ok": True, "errors": [], "note": "no pool built"}
         try:
@@ -1785,13 +2012,19 @@ class ServeEngine:
                         # pool
                         pool = self._ensure_kvpool()
                 self._service_migrations(pool, pools, pf_queue)
-                self._admit_paged(pool, pools, claimed, pf_queue)
+                prog_claimed = [e for e in claimed
+                                if self._is_program_bucket(e.bucket)]
+                lm_claimed = [e for e in claimed
+                              if not self._is_program_bucket(e.bucket)]
+                self._admit_paged(pool, pools, lm_claimed, pf_queue)
+                self._admit_program_rows(pools, prog_claimed)
                 claimed = []
                 with self._cond:
                     if self._gen == gen:  # never clobber a successor's
                         self._claimed = []  # claimed mirror
                 self._prefill_paged_chunk(pool, pools, pf_queue)
                 self._step_paged(pool, pools)
+                self._step_program_rows(pools)
         except BaseException as exc:  # worker death: recover or fail held
             held = [p.entries[i] for p in pools.values()
                     for i in p.occupied_slots()]
@@ -1995,6 +2228,8 @@ class ServeEngine:
 
         launched = []
         for bucket, group in list(pools.items()):
+            if isinstance(group, ProgramRowSet):
+                continue  # the program lane steps in _step_program_rows
             now = self._clock()
             for i in group.occupied_slots():
                 dl = group.entries[i].request.deadline
@@ -2119,6 +2354,9 @@ class ServeEngine:
         pool reference is cleared only when it still names this pool."""
         now = self._clock()
         for bucket, group in list(pools.items()):
+            if isinstance(group, ProgramRowSet):
+                continue  # program rows hold no pages: they ride out a
+                # slab loss untouched and answer on this same iteration
             for i in group.occupied_slots():
                 e = group.entries[i]
                 group.release(i)  # page bookkeeping dies with the pool
@@ -2129,7 +2367,7 @@ class ServeEngine:
                         e.request.rid, STATUS_ERROR, reason=reason,
                         metrics={"bucket": bucket, "queue_s": e.queue_s,
                                  "total_s": now - e.enq_t}))
-        pools.clear()
+            pools.pop(bucket)
         if self._kvpool is pool:
             self._kvpool = None
             self.metrics.record_page_event("lost", used=0,

@@ -36,7 +36,18 @@ picked up) under the single kind ``"serve"`` with an ``ev`` discriminator:
                ``total_s``; retried requests add ``attempt`` (the final,
                serving attempt — latency is attributed to it); paged rows
                add ``pages``/``shared_pages``
+``swap``       one atomic model hot-update on a resident BucketProgram:
+               ``program``
 =============  ===========================================================
+
+Non-LM BucketProgram traffic (serving/programs/) threads a ``program``
+field through its ``enqueue``/``step``/``reject``/``result`` records (LM
+records stay byte-identical — readers default a missing field to ``lm``),
+and aggregates into three labelled families:
+``marlin_serve_program_requests_total{program,status}`` (terminal outcomes
+per serving program), ``marlin_serve_program_rows_total{program}`` (rows
+executed by one-shot program steps), and
+``marlin_serve_program_swaps_total{program}`` (atomic model hot-updates).
 
 The engine activates each request's span context around the rid-carrying
 emits, so one request's ``enqueue``/``prefill``/``result`` records share a
@@ -139,6 +150,9 @@ class ServeMetrics:
         self.migrated_out = 0   # rows exported into a migration blob
         self.migrated_in = 0    # rows adopted mid-stream from a peer
         self.migrate_fallback = 0  # rows that fell back to the retry path
+        self.program_steps = 0  # one-shot program batch dispatches
+        self.program_rows = 0   # rows those dispatches served
+        self.swaps = 0          # atomic model hot-updates (record_swap)
         self._occupancy_sum = 0.0
         self._step_occupancy_sum = 0.0
         self._total_s = Reservoir(keep_latencies, rng)
@@ -198,6 +212,21 @@ class ServeMetrics:
             "marlin_serve_prefix_cache_total",
             "Prefix-cache lookups at row admission by result (hit = at "
             "least one full prompt page reused)", labelnames=("result",))
+        self._m_prog_requests = reg.counter(
+            "marlin_serve_program_requests_total",
+            "Terminal request outcomes by serving program (BucketProgram "
+            "name: lm, als, pagerank, classify, ...) and status",
+            labelnames=("program", "status"))
+        self._m_prog_rows = reg.counter(
+            "marlin_serve_program_rows_total",
+            "Rows executed by one-shot (non-LM) BucketProgram step "
+            "dispatches, by program",
+            labelnames=("program",))
+        self._m_prog_swaps = reg.counter(
+            "marlin_serve_program_swaps_total",
+            "Atomic model hot-updates (swap_model) on resident "
+            "BucketPrograms, by program",
+            labelnames=("program",))
         self._m_migrate = reg.counter(
             "marlin_serve_migrations_total",
             "Cross-replica row migrations by leg (export = rows serialized "
@@ -236,19 +265,30 @@ class ServeMetrics:
         self._m_queue_depth.set(depth)
         self._m_kv_bytes.set(kv_bytes)
 
-    def record_enqueue(self, rid: int, bucket, depth: int) -> None:
+    def record_enqueue(self, rid: int, bucket, depth: int,
+                       program: str | None = None) -> None:
         with self._lock:
             self.submitted += 1
         self._m_submitted.inc()
         # queue-depth gauge: record_queue is the single writer (the engine
         # calls it right after, with the admission gate's own count)
-        self._emit(ev="enqueue", rid=rid, bucket=list(bucket), depth=depth)
+        fields = {"ev": "enqueue", "rid": rid, "bucket": list(bucket),
+                  "depth": depth}
+        if program is not None and program != "lm":
+            fields["program"] = program
+        self._emit(**fields)
 
-    def record_reject(self, rid: int, reason: str) -> None:
+    def record_reject(self, rid: int, reason: str,
+                      program: str | None = None) -> None:
         with self._lock:
             self.rejected += 1
         self._m_requests.labels(status="rejected").inc()
-        self._emit(ev="reject", rid=rid, reason=reason)
+        self._m_prog_requests.labels(program=program or "lm",
+                                     status="rejected").inc()
+        fields = {"ev": "reject", "rid": rid, "reason": reason}
+        if program is not None and program != "lm":
+            fields["program"] = program
+        self._emit(**fields)
 
     def record_prefill(self, bucket, seconds: float,
                        rid: int | None = None,
@@ -284,29 +324,54 @@ class ServeMetrics:
     def record_step(self, bucket, rows: int, max_batch: int,
                     seconds: float,
                     program_key: str | None = None,
-                    program: str = "lm_decode_rows") -> None:
+                    program: str = "lm_decode_rows",
+                    label: str | None = None) -> None:
         """One decode step over a bucket's rows: ``rows`` live slots each
         emitted one token (``new_tokens`` == ``rows``). ``program_key``
         joins the step's wall time onto ``program``'s cost model, feeding
-        ``marlin_program_roofline_frac``."""
+        ``marlin_program_roofline_frac``. ``label`` marks a non-LM
+        BucketProgram batch (the serving-program name, distinct from
+        ``program`` — the ProgramCosts family): its rows are program rows,
+        not generated tokens, so they count into
+        ``marlin_serve_program_rows_total{program}`` instead of the token
+        counters and never touch LM's tok/s arithmetic."""
         with self._lock:
             self.steps += 1
-            self.new_tokens += rows
             self.busy_s += seconds
             self._step_occupancy_sum += rows / max_batch
             self._step_s.add(seconds)
+            if label is None:
+                self.new_tokens += rows
+            else:
+                self.program_steps += 1
+                self.program_rows += rows
         if program_key is not None:
             get_program_costs().observe(program, program_key, seconds)
         self._m_dispatch.labels(kind="step").inc()
-        self._m_tokens.inc(rows)
         self._m_busy.inc(seconds)
         self._m_occupancy.set(rows / max_batch)
         self._m_step.observe(seconds)
         self._ts_observe("marlin_serve_step_seconds", seconds)
-        self._emit(ev="step", bucket=list(bucket), rows=rows,
-                   occupancy=round(rows / max_batch, 4), new_tokens=rows,
-                   seconds=seconds,
-                   tok_s=round(rows / max(seconds, 1e-9), 2))
+        fields = {"ev": "step", "bucket": list(bucket), "rows": rows,
+                  "occupancy": round(rows / max_batch, 4),
+                  "seconds": seconds}
+        if label is None:
+            self._m_tokens.inc(rows)
+            fields["new_tokens"] = rows
+            fields["tok_s"] = round(rows / max(seconds, 1e-9), 2)
+        else:
+            self._m_prog_rows.labels(program=label).inc(rows)
+            fields["new_tokens"] = 0
+            fields["program"] = label
+        self._emit(**fields)
+
+    def record_swap(self, program: str) -> None:
+        """One atomic model hot-update (``swap_model``) installed on a
+        resident BucketProgram."""
+        with self._lock:
+            self.swaps += 1
+        self._m_prog_swaps.labels(program=program).inc()
+        self._emit(ev="swap", program=program)
 
     def record_retry(self, rid: int, attempt: int, max_attempts: int,
                      reason: str) -> None:
@@ -378,7 +443,8 @@ class ServeMetrics:
                       ttft_s: float | None = None,
                       attempt: int = 1,
                       pages: int | None = None,
-                      shared_pages: int | None = None) -> None:
+                      shared_pages: int | None = None,
+                      program: str | None = None) -> None:
         with self._lock:
             if status == "ok":
                 self.completed += 1
@@ -403,6 +469,8 @@ class ServeMetrics:
             if ttft_s is not None:
                 self._ttft_s.add(ttft_s)
         self._m_requests.labels(status=status).inc()
+        self._m_prog_requests.labels(program=program or "lm",
+                                     status=status).inc()
         if total_s is not None:
             self._m_total.observe(total_s)
             self._ts_observe("marlin_serve_total_seconds", total_s)
@@ -412,6 +480,8 @@ class ServeMetrics:
         if queue_s is not None:
             self._ts_observe("marlin_serve_queue_seconds", queue_s)
         fields = {"ev": "result", "rid": rid, "status": status}
+        if program is not None and program != "lm":
+            fields["program"] = program
         if attempt > 1:
             fields["attempt"] = attempt
         if bucket is not None:
@@ -454,6 +524,9 @@ class ServeMetrics:
                 "migrated_out": self.migrated_out,
                 "migrated_in": self.migrated_in,
                 "migrate_fallback": self.migrate_fallback,
+                "program_steps": self.program_steps,
+                "program_rows": self.program_rows,
+                "swaps": self.swaps,
                 "new_tokens": self.new_tokens,
                 "busy_s": round(self.busy_s, 6),
                 "occupancy_mean": (round(occ / dispatches, 4)

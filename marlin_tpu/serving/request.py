@@ -47,11 +47,20 @@ _rid_counter = itertools.count()
 
 @dataclasses.dataclass
 class Request:
-    """One generation request.
+    """One serving request.
 
-    ``prompt`` is a 1-D int32 token array; ``steps`` how many tokens to
-    generate (the bucket rounds it up for execution, the :class:`Result`
-    slices back down). ``deadline`` is an *absolute* time on the engine's
+    ``program`` names the :class:`~marlin_tpu.serving.programs
+    .BucketProgram` that answers it — ``"lm"`` (the default, token
+    generation) or any program the engine was constructed with (``"als"``,
+    ``"pagerank"``, ``"classify"``, ...). Non-LM programs take their input
+    through ``payload`` (a small host-side dict, e.g. ``{"user": 7,
+    "k": 10}``) and need no ``prompt``; every request, whatever its
+    program, shares the same deadline/priority/retry policy surface and
+    the same exactly-once :class:`Result` contract.
+
+    ``prompt`` is a 1-D int32 token array (required for ``program="lm"``,
+    ignored elsewhere); ``steps`` how many tokens to generate (the bucket
+    rounds it up for execution, the :class:`Result` slices back down). ``deadline`` is an *absolute* time on the engine's
     clock (``None`` = no deadline): a request whose deadline has passed when
     its batch forms is retired with :data:`STATUS_EXPIRED` rather than
     decoded late. ``priority`` orders dispatch within a bucket (higher
@@ -88,8 +97,8 @@ class Request:
     uninterrupted run, sampled retries re-derive the same per-row
     ``fold_in(key(seed), step)`` stream (docs/robustness.md)."""
 
-    prompt: Any
-    steps: int
+    prompt: Any = None
+    steps: int = 1
     deadline: float | None = None
     deadline_s: float | None = None
     max_attempts: int = 1
@@ -99,12 +108,18 @@ class Request:
     top_k: int | None = None
     seed: int = 0
     eos: int | None = None
+    program: str = "lm"
+    payload: Any = None
     rid: int = dataclasses.field(default_factory=lambda: next(_rid_counter))
 
     def __post_init__(self):
-        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
-        if self.prompt.size < 1:
-            raise ValueError("empty prompt")
+        if self.prompt is None:
+            if self.program == "lm":
+                raise ValueError("program 'lm' needs a token prompt")
+        else:
+            self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+            if self.prompt.size < 1:
+                raise ValueError("empty prompt")
         if self.steps < 1:
             raise ValueError(f"steps must be >= 1, got {self.steps}")
         if self.max_attempts < 1:
@@ -120,7 +135,9 @@ class Result:
     """The exactly-once answer to one :class:`Request`. ``tokens`` (status
     :data:`STATUS_OK` only) is prompt + the generated tokens — exactly the
     requested ``steps`` of them, or fewer ending in the stop token when
-    ``Request.eos`` fired under the row-level scheduler. ``metrics``
+    ``Request.eos`` fired under the row-level scheduler. Non-LM programs
+    answer through ``value`` instead (the program-shaped payload, e.g.
+    ALS's ``{"items": ..., "scores": ...}``). ``metrics``
     carries the per-request timings on the engine clock (``queue_s``,
     ``ttft_s`` — time to the first generated token, which row-level prefill
     makes genuinely earlier than ``total_s``), the ``bucket`` that executed
@@ -131,6 +148,7 @@ class Result:
     tokens: np.ndarray | None = None
     reason: str = ""
     metrics: dict = dataclasses.field(default_factory=dict)
+    value: Any = None
 
     @property
     def ok(self) -> bool:

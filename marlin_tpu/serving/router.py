@@ -125,7 +125,8 @@ _FAILOVER = (STATUS_REJECTED, STATUS_SHUTTING_DOWN)
 _COUNTER_KEYS = ("submitted", "rejected", "expired", "completed", "errors",
                  "shut_down", "retries", "batches", "steps", "new_tokens",
                  "prefix_hits", "prefix_misses", "migrated_out",
-                 "migrated_in", "migrate_fallback", "busy_s")
+                 "migrated_in", "migrate_fallback", "busy_s",
+                 "program_steps", "program_rows", "swaps")
 
 
 def _prefix_route_key(request, ready) -> bytes | None:
@@ -134,7 +135,12 @@ def _prefix_route_key(request, ready) -> bytes | None:
     deliberately ONLY the first page, so requests sharing a system prompt
     map together whatever their tails do. None when nothing is shareable
     (prompt must be strictly longer than a page: the cache never shares
-    the last-token page) or no ready replica is paged."""
+    the last-token page) or no ready replica is paged. Non-LM BucketProgram
+    requests have no KV prefix to be affine to, so they deterministically
+    fall back to power-of-two-choices placement — mixed traffic load-
+    balances instead of piling onto whichever replica owns a hot prompt."""
+    if getattr(request, "program", "lm") != "lm":
+        return None
     if not get_config().serve_prefix_affinity:
         return None
     prompt = getattr(request, "prompt", None)
@@ -846,7 +852,8 @@ class Router:
         for key in ("submitted", "rejected", "expired", "completed",
                     "errors", "shut_down", "retries", "batches", "steps",
                     "new_tokens", "prefix_hits", "prefix_misses",
-                    "migrated_out", "migrated_in", "migrate_fallback"):
+                    "migrated_out", "migrated_in", "migrate_fallback",
+                    "program_steps", "program_rows", "swaps"):
             agg[key] = (sum(s.get(key, 0) for _, s in snaps)
                         + retired.get(key, 0))
         for key in ("pages_total", "pages_used", "pages_shared"):

@@ -102,6 +102,7 @@ KNOWN_POINTS = frozenset({
     "device.probe", "prefetch.produce", "dataplane.read", "serve.enqueue",
     "serve.step", "serve.prefill", "serve.decode_step", "serve.worker_crash",
     "serve.router_route", "serve.migrate", "serve.fleet",
+    "serve.program_step",
 })
 
 

@@ -511,7 +511,7 @@ def test_report_main_cli(tmp_path, capsys):
 
     assert main([FIXTURE]) == 0
     out = capsys.readouterr().out
-    assert "trace join: 3/3 requests" in out
+    assert "trace join: 6/6 requests" in out
     assert main([]) == 2
     assert main([str(tmp_path / "missing.jsonl")]) == 1
 

@@ -276,17 +276,13 @@ def test_report_paging_line(params, tmp_path):
     assert "page occupancy peak" in text
 
 
-def test_rowlevel_false_is_deprecated_not_fatal(params):
-    """Satellite: old configs setting serve_rowlevel=False (the retired
-    gang fallback) warn and still serve row-level."""
-    with pytest.warns(DeprecationWarning, match="gang"):
-        eng = _engine(params, rowlevel=False)
-    try:
-        r = eng.submit(Request(prompt=[1, 2, 3], steps=2)).result(timeout=60)
-        assert r.status == STATUS_OK
-        assert r.tokens.tolist() == _ref(params, [1, 2, 3], 2)
-    finally:
-        eng.close()
+def test_rowlevel_kwarg_is_removed(params):
+    """Satellite (ISSUE 18): the deprecated ``rowlevel`` escape hatch is
+    gone — passing it (either value) raises a ValueError that points at
+    serve_paged, the knob that actually picks a backend now."""
+    for val in (False, True):
+        with pytest.raises(ValueError, match="serve_paged"):
+            _engine(params, rowlevel=val)
 
 
 # ------------------------------------------------- engine: chunked prefill

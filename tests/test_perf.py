@@ -184,7 +184,7 @@ def test_capture_sites_do_not_retry_failed_traces(lm_params):
                                             capture_bucket_costs)
 
     bad_params = {"emb": np.zeros((4, 4), np.float32)}  # no l0: trace dies
-    capture_bucket_costs(bad_params, HEADS, (8, 4), 4, rowlevel=True)
+    capture_bucket_costs(bad_params, HEADS, (8, 4), 4)
     key = bucket_program_key(bad_params, (8, 4), 4)
     costs = perf.get_program_costs()
     assert costs.tried("lm_decode_rows", key)

@@ -605,7 +605,7 @@ def test_rowlevel_gqa_bit_identical(params):
                         seed=3).init_params()
     rng = np.random.default_rng(8)
     with ServeEngine(gqa, 4, buckets=BUCKETS, max_batch=4, max_wait_ms=0.0,
-                     queue_depth=64, rowlevel=True) as eng:
+                     queue_depth=64) as eng:
         reqs = [Request(prompt=rng.integers(0, 32, int(rng.integers(2, 17)))
                         .astype(np.int32), steps=int(rng.integers(1, 5)))
                 for _ in range(8)]
@@ -747,7 +747,11 @@ def test_aot_compile_buckets_reports_hbm(params):
 
     if not supports_aot_tpu():
         pytest.skip("no libtpu: compile-only TPU topology unavailable")
-    peaks = aot_compile_buckets(params, HEADS, [(8, 4)], max_batch=2)
+    # this tiny model's compiler peak (weights + workspace) dwarfs its KV
+    # slab arithmetic, so the planner-honesty warning MUST fire here — the
+    # same signal that catches a real under-budgeted serve_max_batch
+    with pytest.warns(RuntimeWarning, match="measured peak"):
+        peaks = aot_compile_buckets(params, HEADS, [(8, 4)], max_batch=2)
     assert set(peaks) == {(8, 4)} and peaks[(8, 4)] > 0
 
 

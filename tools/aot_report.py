@@ -107,7 +107,9 @@ def serve_bucket_report() -> dict:
     the usable-HBM budget both are sized against. Where the two columns
     disagree, the compiler wins (round-4 verdict #2); the planner's number
     is what admission will *enforce*, so a planner underestimate here is an
-    OOM waiting for traffic."""
+    OOM waiting for traffic. Each row also records the measured
+    ``peak_planner_ratio`` — planner honesty in one number; past 2x,
+    ``aot_compile_buckets`` itself warns (serving.planner_ratio_warning)."""
     from marlin_tpu.serving import aot_compile_buckets, bucket_kv_bytes
 
     heads, max_batch = 8, 8
@@ -115,17 +117,16 @@ def serve_bucket_report() -> dict:
     lm = TransformerLM(vocab=256, d_model=128, heads=heads, layers=4, seed=0)
     params = lm.init_params()
     t0 = time.time()
-    compiled = aot_compile_buckets(params, heads, buckets, max_batch,
-                                   rowlevel=True)
+    compiled = aot_compile_buckets(params, heads, buckets, max_batch)
     budget = _usable_budget()
-    out = {"model": "d128/h8/L4/v256 rowlevel (bench_all config_serve)",
+    out = {"model": "d128/h8/L4/v256 (bench_all config_serve)",
            "max_batch": max_batch, "usable_hbm_budget_bytes": budget,
            "compile_s": round(time.time() - t0, 1), "buckets": {}}
     # steady-state residency sums over buckets (the engine never frees a
     # slab); program peak is per dispatched bucket
     slab_total = 0
     print(f"  {'bucket':>10} {'compiler peak':>14} {'planner slab':>13} "
-          f"{'of budget':>10}")
+          f"{'peak/plan':>10} {'of budget':>10}")
     for b in buckets:
         slab = bucket_kv_bytes(params, heads, b, batch=max_batch)
         slab_total += slab
@@ -133,9 +134,11 @@ def serve_bucket_report() -> dict:
         out["buckets"][f"{b[0]}x{b[1]}"] = {
             "compiler_peak_bytes": int(peak),
             "planner_slab_bytes": int(slab),
+            "peak_planner_ratio": round(peak / slab, 3) if slab else None,
             "peak_frac_of_budget": round(peak / budget, 4),
         }
         print(f"  {b[0]:>7}x{b[1]:<2} {peak:>14} {slab:>13} "
+              f"{peak / slab if slab else 0:>10.2f} "
               f"{peak / budget:>9.2%}")
     out["planner_slab_total_bytes"] = int(slab_total)
     out["fits_usable_hbm"] = slab_total + max(compiled.values()) < budget

@@ -823,6 +823,7 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
                                                     "0")))
     obs_port = srv.start()  # installs compile + device-memory collectors
     scrape = ""
+    mem_during: dict = {}
 
     def make_engine():
         return ServeEngine(params, heads, buckets=buckets,
@@ -867,8 +868,17 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
                 # slow scrape never inflates the measured span — the tok/s
                 # this sweep records is the passivity evidence.
                 def _scrape_live():
-                    nonlocal scrape
+                    nonlocal scrape, mem_during
                     collectors.log_device_memory(elog)  # mem timeline
+                    try:
+                        # the HBM ledger's mid-serve reconcile: taken while
+                        # the KV slab and programs are still resident, so
+                        # the serve_mem record attributes live bytes, not
+                        # the post-close remainder
+                        from marlin_tpu.obs import memledger
+                        mem_during = memledger.reconcile()
+                    except Exception:
+                        pass
                     try:
                         scrape = urllib.request.urlopen(
                             f"http://127.0.0.1:{obs_port}/metrics",
@@ -1003,7 +1013,12 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
             "marlin_serve_slot_occupancy", "marlin_serve_kv_inflight_bytes",
             "marlin_compile_total", "marlin_prefetch_chunks_total",
             "marlin_device_memory_bytes_in_use",
-            "marlin_program_roofline_frac")
+            "marlin_program_roofline_frac",
+            # the HBM-ledger attribution families (obs/memledger.py) ride
+            # the same scrape: TYPE lines render even before any backend
+            # sample lands, so the check holds on CPU too
+            "marlin_mem_registered_bytes", "marlin_mem_live_bytes",
+            "marlin_mem_unattributed_bytes")
     if paged:
         # the paging families ride only when the paged pool served
         want += ("marlin_serve_kv_pages_total", "marlin_serve_kv_pages_used",
@@ -1023,6 +1038,34 @@ def config_serve(d_model=128, heads=8, layers=4, vocab=256):
            f"live /metrics scrape during serve carried {len(got)}/{len(want)}"
            f" series ({', '.join(got)}); {trace_note}; events at "
            f"{events_path} (analyze: python -m marlin_tpu.obs.report)")
+
+    # ---- memory-attribution acceptance record (HBM ledger,
+    # docs/observability.md "Memory attribution"): the mid-serve reconcile
+    # taken by the scrape thread is the evidence — per-component
+    # attribution while the slab was resident, the unattributed fraction
+    # ("n/a" without backend memory_stats, i.e. CPU), and the
+    # calibrated-vs-raw admission headroom read from AOT_MEMORY.json's
+    # serve_buckets table. Value = marlin_mem_* families on the live
+    # scrape, so the record gates (unit is not informational).
+    from marlin_tpu.obs import memledger
+
+    mem_want = ("marlin_mem_registered_bytes", "marlin_mem_live_bytes",
+                "marlin_mem_unattributed_bytes")
+    mem_got = [n for n in mem_want if f"# TYPE {n} " in scrape]
+    rec = mem_during or memledger.reconcile()
+    frac = rec.get("unattributed_frac")
+    comp = rec.get("components") or {}
+    comp_note = (", ".join(f"{k} {v / 1e6:.1f}MB"
+                           for k, v in sorted(comp.items()))
+                 or "no live ledger entries at scrape time")
+    ratios = [r["calibration"] for r in memledger.ratio_table()
+              if r.get("calibration")]
+    headroom = f"{max(ratios):.2f}" if ratios else "n/a"
+    record("serve_mem" + suffix, float(len(mem_got)), "families",
+           f"{len(mem_got)}/{len(mem_want)} marlin_mem_* families on the "
+           f"live scrape; unattributed frac "
+           f"{frac if frac is not None else 'n/a'}; components: "
+           f"{comp_note}; calib-headroom {headroom}")
 
 
 def config_serve_als(d_model=64, heads=4, layers=2, vocab=256):

@@ -163,6 +163,15 @@ class MarlinConfig:
     # elsewhere (interpret-mode Pallas is for tests, not serving). Greedy
     # token streams are identical across backends.
     serve_decode_kernel: str = "auto"
+    # Measured-peak admission calibration (obs/memledger.py): multiply the
+    # planner's per-bucket admission cost by the compiler-measured
+    # peak/planner ratio for that bucket's program (live ProgramCosts
+    # capture first, the AOT_MEMORY.json serve_buckets table second, 1.0
+    # when neither has measured this exact program), so admission charges
+    # what the program actually peaks at instead of the slab arithmetic
+    # the compiler runs 4-5x above. False = raw planner cost (the
+    # pre-ledger behavior).
+    serve_admission_calibration: bool = True
     # --- serving resilience (serving/supervisor.py, serving/router.py) ------
     # Supervisor watchdog: a worker whose heartbeat is older than this many
     # real seconds while work is pending is declared stuck and recovered
@@ -313,6 +322,13 @@ class MarlinConfig:
     # worker loop, prefetch producers), dumped to JSONL on worker faults /
     # engine close / GET /debug/flight.
     obs_flight_len: int = 256
+    # Leak-detection patience (obs/memledger.py LeakDetector): a component
+    # debited in the MemoryLedger whose backend-reported live bytes have
+    # not dropped after this many reconciliation windows (one per metrics
+    # scrape of the memledger collector) raises a kind="mem" leak event
+    # and fires the SLO-style hooks. Backends without memory_stats (CPU)
+    # never reconcile, so the detector is inert there.
+    obs_mem_leak_windows: int = 3
 
 
 _config = MarlinConfig()

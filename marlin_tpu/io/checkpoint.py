@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import contextlib
 import io as _io
+import itertools
 import json
 import os
 import re
@@ -83,6 +84,44 @@ def _span_event(name: str, **fields):
         else:
             with log.timed("ckpt", **fields):
                 yield
+
+
+_stage_ids = itertools.count()
+
+
+@contextlib.contextmanager
+def _staging_accounted(tag: str):
+    """Account a checkpoint staging buffer — the ``BytesIO`` a leaf is
+    serialized into before it lands on storage — in the process
+    :class:`~marlin_tpu.obs.memledger.MemoryLedger` (component ``ckpt``) for
+    exactly the staging window. The body calls the yielded ``note(nbytes)``
+    once the buffer is built (its size is unknown up front); the entry is
+    debited when the write finishes or raises. Accounting never fails a
+    save."""
+    name = f"ckpt:{tag}#{next(_stage_ids)}"
+    led = None
+    try:
+        from ..obs.memledger import get_ledger
+
+        led = get_ledger()
+    except Exception:
+        led = None
+
+    def note(nbytes: int) -> None:
+        if led is not None:
+            try:
+                led.register(name, max(int(nbytes), 0), "ckpt")
+            except Exception:
+                pass
+
+    try:
+        yield note
+    finally:
+        if led is not None:
+            try:
+                led.free(name, strict=False)
+            except Exception:
+                pass
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -198,9 +237,11 @@ def save_sharded(arr: jax.Array, path: str) -> dict:
     shards = []
     for shard in arr.addressable_shards:
         fname = f"shard_{shard.replica_id}_{'_'.join(map(str, [s.start or 0 for s in shard.index]))}.npy"
-        buf = _io.BytesIO()
-        np.save(buf, np.asarray(shard.data))
-        rec = _write_bytes(join_path(path, fname), buf.getbuffer())
+        with _staging_accounted(fname) as note:
+            buf = _io.BytesIO()
+            np.save(buf, np.asarray(shard.data))
+            note(buf.getbuffer().nbytes)
+            rec = _write_bytes(join_path(path, fname), buf.getbuffer())
         integ[fname] = rec
         shards.append({
             "file": fname,
@@ -490,11 +531,13 @@ def _save_checkpoint(state, path: str, step: int, keep: int | None) -> None:
         # — concurrent same-file npz writes from every process would tear
         if not multiproc or proc == 0:
             ensure_dir(work)
-            buf = _io.BytesIO()
-            np.savez(buf, **{f"leaf_{i}": np.asarray(jax.device_get(x))
-                             for i, x in enumerate(leaves)})
-            integ["state.npz"] = _write_bytes(join_path(work, "state.npz"),
-                                              buf.getbuffer())
+            with _staging_accounted("state.npz") as note:
+                buf = _io.BytesIO()
+                np.savez(buf, **{f"leaf_{i}": np.asarray(jax.device_get(x))
+                                 for i, x in enumerate(leaves)})
+                note(buf.getbuffer().nbytes)
+                integ["state.npz"] = _write_bytes(
+                    join_path(work, "state.npz"), buf.getbuffer())
     else:
         ensure_dir(work)
         for i, x in enumerate(leaves):
@@ -502,10 +545,12 @@ def _save_checkpoint(state, path: str, step: int, keep: int | None) -> None:
                 sub = save_sharded(x, join_path(work, f"leaf_{i}"))
                 integ.update({f"leaf_{i}/{k}": v for k, v in sub.items()})
             elif proc == 0:  # replicated/small leaves: once
-                buf = _io.BytesIO()
-                np.save(buf, np.asarray(jax.device_get(x)))
-                integ[f"leaf_{i}.npy"] = _write_bytes(
-                    join_path(work, f"leaf_{i}.npy"), buf.getbuffer())
+                with _staging_accounted(f"leaf_{i}.npy") as note:
+                    buf = _io.BytesIO()
+                    np.save(buf, np.asarray(jax.device_get(x)))
+                    note(buf.getbuffer().nbytes)
+                    integ[f"leaf_{i}.npy"] = _write_bytes(
+                        join_path(work, f"leaf_{i}.npy"), buf.getbuffer())
     if integ:
         mname = f"integrity_{proc}.json"
         _faults.fire("ckpt.manifest", path=join_path(work, mname))

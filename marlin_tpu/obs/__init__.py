@@ -28,6 +28,11 @@ ServeMetrics counters, StageTimes, a test-only compile tally):
 - :mod:`~marlin_tpu.obs.console` — live terminal ops console
   (``python -m marlin_tpu.obs.console``) polling ``/metrics`` +
   ``/debug/slo``.
+- :mod:`~marlin_tpu.obs.memledger` — the HBM ledger: process-global
+  per-component device-memory attribution with exact debit on free,
+  the three-view reconciler (``marlin_mem_*`` gauges, ``GET
+  /debug/memory``), measured-peak admission calibration, leak
+  detection, and OOM forensics dumps.
 - :mod:`~marlin_tpu.obs.perf` — performance introspection: per-program
   roofline accounting (XLA cost models joined with measured wall times →
   ``marlin_program_*`` series and the analyzer's utilization table), the
@@ -38,6 +43,7 @@ docs/observability.md walks the whole surface.
 """
 
 from . import trace  # noqa: F401  (stdlib-only; must import first — see below)
+from . import memledger  # noqa: F401  (stdlib-only at import; jax lazy)
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -52,7 +58,15 @@ from . import perf  # noqa: F401  (imports jax lazily)
 from .timeseries import TimeSeriesStore, install_collector  # noqa: F401
 from .slo import SloEngine, fleet_merge, objectives_from_config  # noqa: F401
 
-__all__ = ["trace", "collectors", "perf", "Counter", "Gauge", "Histogram",
-           "MetricsRegistry", "get_registry", "percentile", "MetricsServer",
-           "start_from_config", "TimeSeriesStore", "install_collector",
-           "SloEngine", "fleet_merge", "objectives_from_config"]
+from .memledger import (  # noqa: F401
+    MemoryLedger,
+    get_leak_detector,
+    get_ledger,
+)
+
+__all__ = ["trace", "collectors", "memledger", "perf", "Counter", "Gauge",
+           "Histogram", "MetricsRegistry", "get_registry", "percentile",
+           "MetricsServer", "start_from_config", "TimeSeriesStore",
+           "install_collector", "SloEngine", "fleet_merge",
+           "objectives_from_config", "MemoryLedger", "get_ledger",
+           "get_leak_detector"]

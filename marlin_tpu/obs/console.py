@@ -15,6 +15,11 @@ router's, a bench's) and renders, at a poll interval:
   .FleetController` is registered (``/debug/fleet``): replica count vs
   bounds, the live burn streaks, the in-flight action, and the recent
   scale-out/in/rebalance history with outcomes;
+- **memory** — when the server carries ``/debug/memory`` (the
+  MemoryLedger, obs/memledger.py): per-component stacked occupancy of
+  the registered bytes, the live vs unattributed reconciliation ("n/a"
+  on backends without ``memory_stats``), and the per-bucket
+  planner-ratio/calibration table;
 - **event tail** — the recent SLO breach/clear transitions plus the
   migration/restart counters' movement.
 
@@ -30,10 +35,11 @@ from __future__ import annotations
 import json
 import sys
 import time
+import urllib.error
 import urllib.request
 
 __all__ = ["parse_metrics", "metric_value", "sparkline", "bar", "render",
-           "fetch", "fetch_fleet", "main"]
+           "fetch", "fetch_fleet", "fetch_memory", "main"]
 
 _SPARK = "▁▂▃▄▅▆▇█"
 
@@ -124,15 +130,18 @@ def _fmt(v, digits: int = 3) -> str:
 # ----------------------------------------------------------------- render
 
 def render(metrics: dict, slo: dict, history: dict | None = None,
-           width: int = 78, *, fleet: dict | None = None) -> str:
+           width: int = 78, *, fleet: dict | None = None,
+           memory: dict | None = None) -> str:
     """One console frame from a parsed ``/metrics`` dict and a
     ``/debug/slo`` payload. ``history`` maps ``scope/slo`` to the burn-rate
     samples this console has seen (the sparkline source); pass None for a
     single captured frame. ``fleet`` is the optional ``/debug/fleet``
     payload — when present (a FleetController is registered) an elastic
     fleet panel renders between the SLO table and the event tail; old
-    servers without the endpoint render identically to before. Pure — the
-    snapshot test renders captured payloads byte-for-byte."""
+    servers without the endpoint render identically to before. ``memory``
+    is the optional ``/debug/memory`` payload (the MemoryLedger) — same
+    degradation contract. Pure — the snapshot test renders captured
+    payloads byte-for-byte."""
     lines: list[str] = []
     rule = "─" * width
     scopes = list(slo.get("scopes", ()))
@@ -223,6 +232,37 @@ def render(metrics: dict, slo: dict, history: dict | None = None,
     if (fleet or {}).get("fleets"):
         lines.append(rule)
 
+    # memory: per-component stacked occupancy + reconciliation + ratios
+    if memory is not None:
+        comps = memory.get("components") or {}
+        total = memory.get("registered_bytes") or 0
+        live = memory.get("live_bytes", "n/a")
+        unatt = memory.get("unattributed_frac", "n/a")
+        audit_ok = (memory.get("audit") or {}).get("ok", True)
+        lines.append(
+            f"  memory: registered={int(total)} live={live} "
+            f"unattributed={unatt if isinstance(unatt, str) else f'{unatt * 100:.1f}%'}"
+            f"{'' if audit_ok else '  LEDGER AUDIT VIOLATED'}")
+        for comp, b in sorted(comps.items(), key=lambda kv: -kv[1]):
+            frac = b / total if total else 0.0
+            lines.append(f"    {comp:<12}{bar(frac)} {b:>14}")
+        ratios = memory.get("planner_ratios") or ()
+        if ratios:
+            lines.append("    bucket       planner B      measured B  "
+                         "ratio  calib")
+            for r in ratios:
+                lines.append(
+                    f"    {str(r.get('bucket', '?')):<10}"
+                    f"{_fmt(r.get('planner_bytes')):>12} "
+                    f"{_fmt(r.get('measured_peak_bytes')):>15}  "
+                    f"{_fmt(r.get('planner_ratio')):>5}  "
+                    f"{_fmt(r.get('calibration')):>5}")
+        for a in list(memory.get("leak_alerts") or ())[-3:]:
+            lines.append(f"    LEAK {a.get('component', '?')}: freed "
+                         f"{a.get('freed_bytes', '?')} B, live held over "
+                         f"{a.get('windows', '?')} window(s)")
+        lines.append(rule)
+
     # event tail: SLO transitions + migration/restart counter movement
     shed = metric_value(metrics, "marlin_slo_shed_total")
     mig_out = metric_value(metrics, "marlin_serve_migrations_total",
@@ -272,6 +312,27 @@ def fetch_fleet(base_url: str, timeout: float = 3.0) -> dict | None:
     return payload if payload.get("fleets") else None
 
 
+def fetch_memory(base_url: str, timeout: float = 3.0) -> dict | None:
+    """The ``/debug/memory`` payload, or None when the server predates
+    the endpoint — the console degrades to the memory-less layout. A 503
+    (ledger audit violation) still renders: that frame is the one an
+    operator most needs to see."""
+    base = base_url.rstrip("/")
+    try:
+        with urllib.request.urlopen(base + "/debug/memory",
+                                    timeout=timeout) as r:
+            return json.loads(r.read().decode("utf-8", "replace"))
+    except urllib.error.HTTPError as e:
+        if e.code == 503:  # audit violation: payload rides the error body
+            try:
+                return json.loads(e.read().decode("utf-8", "replace"))
+            except Exception:
+                return None
+        return None
+    except Exception:
+        return None
+
+
 def main(argv=None) -> int:
     """``python -m marlin_tpu.obs.console [--url U] [--interval S]
     [--once] [--no-clear]`` — poll and render until interrupted."""
@@ -308,7 +369,8 @@ def main(argv=None) -> int:
                     history.setdefault(key, []).append(
                         o.get("burn_rate", 0.0) or 0.0)
                     del history[key][:-64]
-            frame = render(metrics, slo, history, fleet=fetch_fleet(url))
+            frame = render(metrics, slo, history, fleet=fetch_fleet(url),
+                           memory=fetch_memory(url))
         if clear and not once:
             sys.stdout.write("\x1b[2J\x1b[H")
         sys.stdout.write(frame)

@@ -30,6 +30,12 @@ fixture watches the prefix; :meth:`close` joins it):
   (:meth:`~marlin_tpu.serving.fleet.FleetController.payload`: replica
   view, burn streaks, in-flight/recent scale actions, bounds) as JSON —
   why the fleet is (not) resizing, scrapeable in production.
+- ``GET /debug/memory`` — the process MemoryLedger's full account
+  (:func:`marlin_tpu.obs.memledger.memory_payload`: per-component
+  registered bytes, the self-audit, live vs unattributed reconciliation
+  — "n/a" on backends without ``memory_stats`` — the per-bucket
+  planner-ratio table, recent leak alerts) as JSON; 503 when the audit
+  reports an accounting violation.
 
 :func:`start_from_config` is the config-driven entry: it starts a server
 when ``config.obs_http_port`` is set (0 = ephemeral port), installs the
@@ -260,6 +266,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                         "application/json")
         elif path == "/debug/fleet":
             code, payload = fleet_payload()
+            self._reply(code, (json.dumps(payload) + "\n").encode(),
+                        "application/json")
+        elif path == "/debug/memory":
+            from .memledger import memory_payload
+
+            code, payload = memory_payload()
             self._reply(code, (json.dumps(payload) + "\n").encode(),
                         "application/json")
         else:

@@ -51,10 +51,10 @@ __all__ = ["load_events", "parse_when", "trace_join", "analyze", "main",
 #: static analyzer (tools/analyze, doc-sync check) fails the gate on any
 #: emission site this set does not cover.
 KNOWN_KINDS = frozenset({
-    "ckpt", "compile", "fleet", "flight", "memory", "prefetch", "profile",
-    "program", "resume", "resume_skip", "retry", "retry_deadline",
-    "retry_exhausted", "serve", "slo", "stage_times", "step_failure",
-    "timer",
+    "ckpt", "compile", "fleet", "flight", "mem", "memory", "prefetch",
+    "profile", "program", "resume", "resume_skip", "retry",
+    "retry_deadline", "retry_exhausted", "serve", "slo", "stage_times",
+    "step_failure", "timer",
 })
 
 #: the ``ev=`` discriminators of ``kind="serve"`` records (the
@@ -378,6 +378,46 @@ def _timeline_section(events: list[dict], t0: float) -> list[str]:
     return out
 
 
+def _memory_attribution_section(events: list[dict]) -> list[str]:
+    """The MemoryLedger's post-hoc view over ``kind="mem"`` records
+    (obs/memledger.py): the LAST per-component attribution snapshot
+    (engines emit one at terminal close), every leak verdict, and every
+    OOM forensics artifact the run dumped. Renders only when the stream
+    carries mem records, so pre-ledger logs golden byte-identical."""
+    mem = [r for r in events if r.get("kind") == "mem"]
+    if not mem:
+        return []
+    out = ["== memory attribution =="]
+    snaps = [r for r in mem if r.get("ev") == "snapshot"
+             and isinstance(r.get("components"), dict)]
+    if snaps:
+        last = snaps[-1]
+        total = last.get("total_bytes", 0)
+        out.append(f"ledger snapshots: {len(snaps)}; last attribution "
+                   f"({total} bytes registered):")
+        for comp, b in sorted(last["components"].items()):
+            frac = f" ({b / total * 100:.1f}%)" if total else ""
+            out.append(f"  {comp:<12}{b:>14}{frac}")
+        if not last["components"]:
+            out.append("  (ledger empty at snapshot)")
+    leaks = [r for r in mem if r.get("ev") == "leak"]
+    if leaks:
+        out.append(f"leak alerts: {len(leaks)}")
+        for r in leaks[:10]:
+            out.append(f"  {r.get('component', '?')}: freed "
+                       f"{r.get('freed_bytes', '?')} B, live dropped "
+                       f"{r.get('live_drop_bytes', '?')} B over "
+                       f"{r.get('windows', '?')} window(s)")
+    dumps = [r for r in mem if r.get("ev") == "oom_dump"]
+    if dumps:
+        out.append(f"OOM forensics dumps: {len(dumps)}")
+        for r in dumps[:10]:
+            out.append(f"  {r.get('reason', '?')} -> {r.get('path', '?')}")
+    if len(out) == 1:
+        out.append(f"({len(mem)} mem record(s), no snapshot/leak/oom)")
+    return out
+
+
 def analyze(events: list[dict], skipped: int = 0) -> str:
     """The full deterministic report for one event stream."""
     out = ["== marlin_tpu.obs.report =="]
@@ -397,6 +437,10 @@ def analyze(events: list[dict], skipped: int = 0) -> str:
     out.extend(_serving_section(events))
     out.append("")
     out.extend(_program_section(events))
+    mem_sec = _memory_attribution_section(events)
+    if mem_sec:
+        out.append("")
+        out.extend(mem_sec)
     out.append("")
     out.extend(_timeline_section(events, t0))
     return "\n".join(out) + "\n"

@@ -20,6 +20,8 @@ enqueued back-to-back and forced once with a scalar fetch — the same
 
 from __future__ import annotations
 
+import contextlib
+import itertools
 import json
 import os
 import tempfile
@@ -34,6 +36,33 @@ __all__ = ["tune_multiply", "best_strategy", "tune_gemm", "best_gemm",
            "tune_bsr", "best_bsr_strategy", "clear_cache"]
 
 _CACHE: dict[tuple, str] = {}
+
+_scratch_ids = itertools.count()
+
+
+@contextlib.contextmanager
+def _scratch_accounted(tag: str, nbytes: int):
+    """Account tuning-time scratch — the candidate result buffer held live
+    across the timing loop — in the process MemoryLedger (component
+    ``autotune``) for exactly the measurement window. Accounting never
+    fails a tune."""
+    name = f"autotune:{tag}#{next(_scratch_ids)}"
+    led = None
+    try:
+        from ..obs.memledger import get_ledger
+
+        led = get_ledger()
+        led.register(name, max(int(nbytes), 0), "autotune")
+    except Exception:
+        led = None
+    try:
+        yield
+    finally:
+        if led is not None:
+            try:
+                led.free(name, strict=False)
+            except Exception:
+                pass
 
 # Disk layer: tuned winners persist across process restarts (timing a full
 # candidate set costs seconds at production sizes — paying it once per
@@ -240,23 +269,27 @@ def tune_multiply(mat, other, strategies=None, reps: int = 3,
             prec=precision or "config", devices=mat.mesh.devices.size)
 
     results = []
-    for s in strategies:
-        try:
-            c = mat.multiply(other, strategy=s, precision=precision)  # compile
-            evaluate(c)
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                c = mat.multiply(other, strategy=s, precision=precision)
-            evaluate(c)
-            elapsed = time.perf_counter() - t0
-            results.append((s, elapsed / reps))
-            costs.capture("multiply", _prog_key(s), cost=analytic)
-            costs.observe("multiply", _prog_key(s), elapsed, calls=reps)
-        except UnknownStrategyError:
-            # an engine rejecting the strategy name is a skippable candidate;
-            # any other ValueError is a genuinely broken run (layout/shape
-            # validation inside an engine) and must surface
-            continue
+    with _scratch_accounted(f"multiply:{m}x{k}x{n}",
+                            m * n * max(a_item, b_item)):
+        for s in strategies:
+            try:
+                c = mat.multiply(other, strategy=s,
+                                 precision=precision)  # compile
+                evaluate(c)
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    c = mat.multiply(other, strategy=s, precision=precision)
+                evaluate(c)
+                elapsed = time.perf_counter() - t0
+                results.append((s, elapsed / reps))
+                costs.capture("multiply", _prog_key(s), cost=analytic)
+                costs.observe("multiply", _prog_key(s), elapsed, calls=reps)
+            except UnknownStrategyError:
+                # an engine rejecting the strategy name is a skippable
+                # candidate; any other ValueError is a genuinely broken run
+                # (layout/shape validation inside an engine) and must
+                # surface
+                continue
     if not results:
         raise ValueError("no viable multiply strategy could be timed")
     costs.emit("multiply")  # utilization snapshots for the analyzer's table
@@ -312,32 +345,35 @@ def _gemm_key(m: int, k: int, n: int, dtype) -> tuple:
 
 
 def _time_candidates(program: str, candidates, run, prog_key, analytic,
-                     reps: int):
+                     reps: int, scratch_bytes: int = 0):
     """Shared measurement loop: compile, time ``reps`` back-to-back calls
     (utils.profiling.evaluate forces true completion), land each candidate
     in ProgramCosts with the problem's analytic cost — achieved-FLOP/s per
     candidate is the ranking the report table shows. A candidate that
     fails to build/run is skipped, not fatal (the family generator can
-    propose a tile the backend rejects)."""
+    propose a tile the backend rejects). ``scratch_bytes`` accounts the
+    tuning window's result-buffer residency in the memory ledger."""
     from ..obs import perf
     from ..utils.profiling import evaluate
 
     costs = perf.get_program_costs()
     results = []
-    for name in candidates:
-        try:
-            evaluate(run(name))  # compile outside the timed window
-            t0 = time.perf_counter()
-            out = None
-            for _ in range(reps):
-                out = run(name)
-            evaluate(out)
-            elapsed = time.perf_counter() - t0
-        except Exception:
-            continue
-        results.append((name, elapsed / reps))
-        costs.capture(program, prog_key(name), cost=analytic)
-        costs.observe(program, prog_key(name), elapsed, calls=reps)
+    with _scratch_accounted(program, scratch_bytes) if scratch_bytes \
+            else contextlib.nullcontext():
+        for name in candidates:
+            try:
+                evaluate(run(name))  # compile outside the timed window
+                t0 = time.perf_counter()
+                out = None
+                for _ in range(reps):
+                    out = run(name)
+                evaluate(out)
+                elapsed = time.perf_counter() - t0
+            except Exception:
+                continue
+            results.append((name, elapsed / reps))
+            costs.capture(program, prog_key(name), cost=analytic)
+            costs.observe(program, prog_key(name), elapsed, calls=reps)
     if not results:
         raise ValueError(f"no {program} candidate could be timed")
     costs.emit(program)
@@ -385,7 +421,7 @@ def tune_gemm(a, b, candidates=None, reps: int = 3) -> list[tuple[str, float]]:
                                 dtype=str(a.dtype))
 
     results = _time_candidates("gemm", candidates, run, prog_key, analytic,
-                               reps)
+                               reps, scratch_bytes=m * n * item)
     if not explicit:
         key = _gemm_key(m, k, n, a.dtype)
         _CACHE[key] = results[0][0]
@@ -464,7 +500,8 @@ def tune_bsr(bsr, b, candidates=None, reps: int = 2) -> list[tuple[str, float]]:
                                 bs=bs, nnzb=bsr.nnzb, p=p)
 
     results = _time_candidates("bsr_spmm", candidates, run, prog_key,
-                               analytic, reps)
+                               analytic, reps,
+                               scratch_bytes=bsr.shape[0] * p * item)
     if not explicit:
         key = _bsr_key(bsr, p, arr.dtype)
         _CACHE[key] = results[0][0]

@@ -62,6 +62,18 @@ _flight = None  # lazy shared flight recorder: all pipelines, one black box
 _flight_lock = threading.Lock()
 
 
+def _ledger_add(delta: int) -> None:
+    """Mirror an in-flight-bytes gauge delta into the process MemoryLedger
+    (component ``prefetch``, one shared flow entry — the scrape-time
+    reconciler attributes prefetched-but-unconsumed HBM). Never raises."""
+    try:
+        from ..obs.memledger import get_ledger
+
+        get_ledger().add("prefetch:inflight", delta, "prefetch")
+    except Exception:
+        pass
+
+
 def _flight_ring() -> "perf.FlightRecorder":
     """The prefetch flight recorder (obs/perf.py): per-chunk production
     records from every pipeline's producer threads, dumped to JSONL when a
@@ -216,6 +228,7 @@ class ChunkPrefetcher:
                     # against a chunk that will never be admitted
                     self._inflight_bytes -= admitted
                     self._metrics[3].dec(admitted)  # refund the gauge too
+                    _ledger_add(-admitted)
                     if self._next_admit == i:
                         self._next_admit = i + 1
                     self._cv.notify_all()
@@ -262,6 +275,7 @@ class ChunkPrefetcher:
             # gauges move by deltas: several pipelines may run concurrently
             # and the scrape must see their sum, not the last writer
             self._metrics[3].inc(nbytes)
+            _ledger_add(nbytes)
             self._cv.notify_all()
             return True
 
@@ -311,6 +325,7 @@ class ChunkPrefetcher:
         with self._cv:
             self._inflight_bytes -= nbytes
             self._metrics[3].dec(nbytes)
+            _ledger_add(-nbytes)
             self._cv.notify_all()
         self._slots.release()
         self._metrics[0].inc()
@@ -336,6 +351,7 @@ class ChunkPrefetcher:
             # gauges — a concurrent pipeline's buffered chunks stay counted
             self._metrics[2].dec(len(self._ready))
             self._metrics[3].dec(self._inflight_bytes)
+            _ledger_add(-self._inflight_bytes)
             self._ready.clear()
             self._inflight_bytes = 0
             self._cv.notify_all()

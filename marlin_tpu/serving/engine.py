@@ -92,7 +92,7 @@ import weakref
 import numpy as np
 
 from ..config import get_config
-from ..obs import perf, trace as obs_trace
+from ..obs import memledger, perf, trace as obs_trace
 from ..obs.collectors import compile_count as _compile_count
 from ..obs.exposition import (register_health_provider,
                               register_kvpool_provider,
@@ -122,6 +122,7 @@ class MigrationError(RuntimeError):
     router falls back to the PR 7 retry path on it."""
 
 _engine_ids = itertools.count()
+_mig_tokens = itertools.count()  # distinct migration-blob ledger names
 
 # real-seconds cap on one condition wait under an INJECTED clock: bounds how
 # stale the worker's view of a fake clock can get (tests advance it between
@@ -332,6 +333,9 @@ class ServeEngine:
         self._heartbeat: float | None = None  # real clock; worker stamps it
         self._live_rows = 0                   # worker-written, healthz-read
         self._prog_keys: dict[tuple, str] = {}
+        # per-bucket measured-peak admission ratio (obs/memledger.py),
+        # resolved once on first admission to that bucket
+        self._calib_ratios: dict[tuple, float] = {}
         self._finalized = False
         # readiness: /healthz reports this engine's lifecycle and 503s once
         # it leaves "accepting" (weakref — the provider must never pin a
@@ -541,6 +545,32 @@ class ServeEngine:
             self._prog_keys[bucket] = key
         return key
 
+    def _calibrate_cost(self, request, pbucket, cost: int) -> int:
+        """Measured-peak admission calibration (obs/memledger.py): scale
+        the planner's per-bucket charge by the compiler-measured
+        peak/planner ratio for this bucket's program key, so admission
+        stops over-admitting by the 4-5x the slab arithmetic under-counts
+        (AOT_MEMORY.json serve_buckets). LM only — one-shot programs
+        price their actual padded device row; the ratio resolves once per
+        bucket (live ProgramCosts first, the AOT table second, 1.0 when
+        neither measured this exact program) and is cached."""
+        if request.program != "lm":
+            return cost
+        ratio = self._calib_ratios.get(pbucket)
+        if ratio is None:
+            from .batcher import bucket_kv_bytes
+
+            planner = bucket_kv_bytes(self.params, self.heads, pbucket,
+                                      self.compute_dtype,
+                                      batch=self.max_batch)
+            programs = (("lm_prefill_paged", "lm_decode_paged")
+                        if self.paged
+                        else ("lm_prefill_slot", "lm_decode_rows"))
+            ratio = memledger.admission_ratio(planner, programs,
+                                              self._prog_key(pbucket))
+            self._calib_ratios[pbucket] = ratio
+        return int(cost * ratio) if ratio != 1.0 else cost
+
     def _ensure_kvpool(self) -> PagedKVPool:
         """The engine's one paged pool, built lazily (warmup or the first
         admission) and rebuilt zeroed after a recovery or slab loss."""
@@ -553,6 +583,14 @@ class ServeEngine:
                 self.params, self.heads, self._num_pages, self._page_len,
                 self.compute_dtype, self._prefix_cache)
             self.metrics.record_pages(pool.capacity, 0, 0)
+            # account the slab in the process memory ledger: the free rides
+            # every drop path (recovery, slab loss, terminal close), so a
+            # rebuild re-registers the same name without double-counting
+            led = memledger.get_ledger()
+            led.free(f"kvpool:{self._name}", strict=False)
+            led.register(f"kvpool:{self._name}",
+                         self._num_pages * self._page_bytes, "kvpool",
+                         owner=self._name)
         return pool
 
     def _record_pages(self, pool) -> None:
@@ -583,6 +621,14 @@ class ServeEngine:
                          if n != "lm" and p.cost_program]
             for prog in dict.fromkeys(families):
                 perf.get_program_costs().emit(prog)
+        except Exception:
+            pass
+        # a terminated engine must leave the memory ledger clean: sweep
+        # everything it still owns (the KV slab, unconsumed migration
+        # blobs) and land one attribution snapshot for the post-hoc report
+        try:
+            memledger.get_ledger().free_owner(self._name)
+            memledger.emit_snapshot()
         except Exception:
             pass
         unregister_health_provider(self._name)
@@ -781,6 +827,8 @@ class ServeEngine:
             cost = prog.admission_cost(request, pbucket)
         except ValueError as exc:
             return self._refuse(handle, STATUS_REJECTED, str(exc))
+        if get_config().serve_admission_calibration:
+            cost = self._calibrate_cost(request, pbucket, cost)
         reason = self._queue.try_admit(
             cost, priority=request.priority,
             deadline_slack_s=(request.deadline - now
@@ -1373,6 +1421,8 @@ class ServeEngine:
         — it is rebuilt zeroed on the next admission."""
         pool = pools[bucket]
         reason = f"decode step failed: {type(exc).__name__}: {exc}"
+        if memledger.is_oom_error(exc):
+            memledger.dump_oom_forensics(reason)
         self.flight.record("decode_fault", bucket=list(bucket),
                            rows=len(pool.live_slots()), error=reason,
                            queue_depth=self._queue.count,
@@ -1398,6 +1448,8 @@ class ServeEngine:
         case they fail/retry too and the pool is dropped."""
         now = self._clock()
         reason = f"prefill failed: {type(exc).__name__}: {exc}"
+        if memledger.is_oom_error(exc):
+            memledger.dump_oom_forensics(reason)
         if entry.attempts_left():
             self._requeue(entry, reason)
         else:
@@ -1472,6 +1524,9 @@ class ServeEngine:
             # with the worker: drop it wholesale; it rebuilds zeroed on
             # the fresh generation's first admission (page-unit admission
             # reservations ride the requeued twins, never re-charged)
+            if self._kvpool is not None:
+                memledger.get_ledger().free(f"kvpool:{self._name}",
+                                            strict=False)
             self._kvpool = None
             seen = set()
             for e in stash:
@@ -1618,11 +1673,21 @@ class ServeEngine:
                 # engine — nothing leaks into the blob's absence)
                 fallback.extend(entries.values())
                 entries = {}
+        token = None
+        if blob is not None:
+            # the frozen blob is migration bytes in flight: credit it to
+            # this engine until the adopt side consumes it (adopt_rows
+            # transfers ownership to the target, then debits exactly once;
+            # a never-adopted blob is swept by _finalize_obs's free_owner)
+            token = f"migration:{self._name}:{next(_mig_tokens)}"
+            memledger.get_ledger().register(token, len(blob), "migration",
+                                            owner=self._name)
         with self._cond:
             self._state = "frozen"
         self._flight_dump("freeze")
         return {"engine": self, "blob": blob, "entries": entries,
-                "queued": list(queued), "fallback": fallback}
+                "queued": list(queued), "fallback": fallback,
+                "ledger_token": token}
 
     def _export_row(self, group, bucket, slot: int) -> dict:
         """One row's migration manifest: block table (position order),
@@ -1689,25 +1754,40 @@ class ServeEngine:
             if self._idle:
                 self._heartbeat = time.monotonic()
             self._cond.notify_all()
-        if not ev.wait(timeout):
-            # cancel under the lock: rows not yet bound will be released
-            # by the worker when it gets there; rows already bound are
-            # this engine's responsibility now — report them adopted so
-            # the caller neither twins nor re-places them
-            with self._cond:
-                box["cancelled"] = True
-                bound = set(box["bound"])
-            return {"adopted": sorted(bound),
-                    "fallback": [e for rid, e in entries.items()
-                                 if rid not in bound]}
-        err = box.get("error")
-        if err is not None:
-            if isinstance(err, MigrationError):
-                raise err
-            raise MigrationError(
-                f"adopt failed on {self._name}: {type(err).__name__}: "
-                f"{err}") from err
-        return box["result"]
+        # the handoff is committed: the blob's ledger entry moves to this
+        # engine (source debited, target credited — one transfer, the
+        # process total never moves) and is debited exactly once below,
+        # whichever way the adopt resolves (bound, timeout, or error —
+        # after the post the blob is consumed or dead either way). The
+        # not-accepting raise above leaves the entry with the source, so
+        # a retry against another replica still finds it.
+        token = frozen.get("ledger_token")
+        led = memledger.get_ledger()
+        if token:
+            led.transfer(token, owner=self._name)
+        try:
+            if not ev.wait(timeout):
+                # cancel under the lock: rows not yet bound will be released
+                # by the worker when it gets there; rows already bound are
+                # this engine's responsibility now — report them adopted so
+                # the caller neither twins nor re-places them
+                with self._cond:
+                    box["cancelled"] = True
+                    bound = set(box["bound"])
+                return {"adopted": sorted(bound),
+                        "fallback": [e for rid, e in entries.items()
+                                     if rid not in bound]}
+            err = box.get("error")
+            if err is not None:
+                if isinstance(err, MigrationError):
+                    raise err
+                raise MigrationError(
+                    f"adopt failed on {self._name}: {type(err).__name__}: "
+                    f"{err}") from err
+            return box["result"]
+        finally:
+            if token:
+                led.free(token, strict=False)
 
     def adopt_entries(self, entries) -> bool:
         """Queue-only handoff for migrated work WITHOUT device state — the
@@ -2083,6 +2163,10 @@ class ServeEngine:
                     owned = pool.alloc(need - len(spages))
                 except PagePoolExhausted as exc:
                     pool.release(spages)  # drop the refs the match took
+                    # the OOM post-mortem lands BEFORE the retry path runs
+                    # (the retry rebuilds state and destroys the evidence)
+                    memledger.dump_oom_forensics(
+                        f"page allocation failed for rid {r.rid}: {exc}")
                     reason = f"page allocation failed: {exc}"
                     if e.attempts_left():
                         self._requeue(e, reason)
@@ -2369,6 +2453,8 @@ class ServeEngine:
                                  "total_s": now - e.enq_t}))
             pools.pop(bucket)
         if self._kvpool is pool:
+            memledger.get_ledger().free(f"kvpool:{self._name}",
+                                        strict=False)
             self._kvpool = None
             self.metrics.record_page_event("lost", used=0,
                                            total=self._num_pages - 1)
@@ -2388,6 +2474,8 @@ class ServeEngine:
             # pass would KeyError on the cleared pools map
             return
         reason = f"decode step failed: {type(exc).__name__}: {exc}"
+        if memledger.is_oom_error(exc):
+            memledger.dump_oom_forensics(reason)
         self.flight.record("decode_fault", bucket=list(bucket),
                            rows=len(group.live_slots()), error=reason,
                            queue_depth=self._queue.count,
@@ -2417,6 +2505,8 @@ class ServeEngine:
         group = pools[bucket]
         e = group.entries[slot]
         reason = f"prefill failed: {type(exc).__name__}: {exc}"
+        if memledger.is_oom_error(exc):
+            memledger.dump_oom_forensics(reason)
         self.flight.record("prefill_fault", bucket=list(bucket),
                            rid=e.request.rid, error=reason,
                            queue_depth=self._queue.count,

@@ -83,6 +83,7 @@ class ALSScoreProgram(BucketProgram):
                 f"{self.num_items} (got {cfg.serve_program_topk!r})")
         self._ks = ks
         self.swap_count = 0
+        self._ledger_register(self._uf, self._pf)
 
     def swap_model(self, model) -> None:
         """Atomically install freshly trained factors. Shapes must match
@@ -96,6 +97,7 @@ class ALSScoreProgram(BucketProgram):
         with self._lock:
             self._uf, self._pf = uf, pf
             self.swap_count += 1
+        self._ledger_register(self._uf, self._pf)
 
     # ---------------------------------------------------------------- policy
     def buckets(self):

@@ -176,6 +176,32 @@ class BucketProgram:
         return None
 
     # --------------------------------------------------------------- helpers
+    def _ledger_register(self, *trees) -> None:
+        """Account this program's device-resident model buffers in the
+        process :class:`~marlin_tpu.obs.memledger.MemoryLedger` (component
+        ``program``) — called at construction and after every hot
+        ``swap_model``, where the free-then-register pair debits the old
+        weights and credits the new ones exactly (the ledger entry name is
+        per-instance, so two programs of one class never collide). Never
+        raises — accounting must not fail a swap."""
+        try:
+            from ...obs import memledger
+
+            try:
+                import jax
+
+                leaves = jax.tree_util.tree_leaves(list(trees))
+            except Exception:
+                leaves = list(trees)
+            nbytes = sum(int(getattr(l, "nbytes", 0) or 0) for l in leaves)
+            led = memledger.get_ledger()
+            entry = f"program:{self.name}#{id(self)}"
+            led.free(entry, strict=False)
+            led.register(entry, nbytes, "program",
+                         owner=f"program:{self.name}")
+        except Exception:
+            pass
+
     def _capture_cost(self, key: str, fn, *args, **static) -> None:
         """Land one compile-cost record for ``fn(*args, **static)`` in
         ProgramCosts unless already tried — warmup bookkeeping shared by

@@ -75,6 +75,7 @@ class ClassifyProgram(BucketProgram):
         self._kind, self._params, self.feature_dim, self.num_outputs = \
             _model_arrays(model, activation)
         self.swap_count = 0
+        self._ledger_register(self._params)
 
     def swap_model(self, model) -> None:
         """Atomically install new weights of the same shape (same compiled
@@ -89,6 +90,7 @@ class ClassifyProgram(BucketProgram):
         with self._lock:
             self._params = params
             self.swap_count += 1
+        self._ledger_register(self._params)
 
     # ---------------------------------------------------------------- policy
     def buckets(self):

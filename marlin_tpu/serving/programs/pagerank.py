@@ -84,6 +84,8 @@ class PageRankQueryProgram(BucketProgram):
                 f"{self.num_edges} (got {cfg.serve_program_topk!r})")
         self._ks = ks
         self.refresh_count = 0
+        self._ledger_register(op.src, op.dst, op.inv_deg, op.dangling,
+                              self._ranks)
 
     def refresh(self, iterations: int = 1) -> np.ndarray:
         """Advance the resident rank vector ``iterations`` power steps and

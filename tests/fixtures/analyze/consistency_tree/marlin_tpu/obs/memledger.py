@@ -1,0 +1,5 @@
+"""HBM-ledger registry (fixture). Drift is planted on purpose:
+``mystery_comp`` has no row in the doc's memory-attribution table, and
+the doc table carries a ghost ``phantom_comp`` row."""
+
+KNOWN_COMPONENTS = ("kvpool", "mystery_comp", "program")

@@ -37,6 +37,7 @@ import jax
 
 from marlin_tpu.models import TransformerLM
 from marlin_tpu.models.transformer import lm_generate
+from marlin_tpu.obs import memledger
 from marlin_tpu.obs.exposition import fleet_payload
 from marlin_tpu.serving import (
     STATUS_OK,
@@ -801,6 +802,7 @@ def test_kill_donor_mid_scale_in_stays_lossless(params):
     scale-in degrades to the retry path: every request still reaches
     exactly one ok Result (bit-identical) and no page leaks anywhere —
     the PR 12 guarantee carried onto the retire path."""
+    memledger.reset_ledger()
     for leg in ("export:", "adopt:"):
         router = Router(_factory(params, max_batch=8, queue_depth=512,
                                  num_pages=512),
@@ -829,6 +831,17 @@ def test_kill_donor_mid_scale_in_stays_lossless(params):
                 audit = rep.engine.kvpool_audit()
                 assert audit["ok"], (leg, audit["errors"])
             assert router.pending() == 0
+            # the ledger balances after the faulted retire: the donor's
+            # bytes and any in-flight migration blob were debited exactly
+            # once, and only the survivor still owns device memory
+            led = memledger.get_ledger()
+            mem_audit = led.audit()
+            assert mem_audit["ok"], (leg, mem_audit["errors"])
+            assert led.totals().get("migration", 0) == 0
+            live = {rep.engine._name for rep in router._replicas}
+            for e in led.entries():
+                if e["component"] in ("kvpool", "migration"):
+                    assert e["owner"] in live, (leg, e)
         finally:
             router.close()
 
@@ -875,6 +888,7 @@ def test_fleet_chaos_soak(params):
     restart from token 0 (the killed legs abort before any state moves),
     and every surviving pool audits clean. The burn signal is scripted
     (test_slo.py owns the SLO windows); the actions are entirely real."""
+    memledger.reset_ledger()
     router = Router(_factory(params, max_batch=8, queue_depth=1024,
                              num_pages=512),
                     replicas=1,
@@ -977,6 +991,16 @@ def test_fleet_chaos_soak(params):
         for rep in router._replicas:
             audit = rep.engine.kvpool_audit()
             assert audit["ok"], audit["errors"]
+        # nine scale events later the ledger still balances exactly: no
+        # retired replica or aborted scale event left bytes behind
+        led = memledger.get_ledger()
+        mem_audit = led.audit()
+        assert mem_audit["ok"], mem_audit["errors"]
+        assert led.totals().get("migration", 0) == 0
+        live = {rep.engine._name for rep in router._replicas}
+        for e in led.entries():
+            if e["component"] in ("kvpool", "migration"):
+                assert e["owner"] in live, e
     finally:
         stop.set()
         ctl.close()

@@ -37,6 +37,7 @@ import jax
 
 from marlin_tpu.models import TransformerLM
 from marlin_tpu.models.transformer import lm_generate
+from marlin_tpu.obs import memledger
 from marlin_tpu.obs.exposition import (kvpool_payload,
                                        register_kvpool_provider,
                                        unregister_kvpool_provider)
@@ -258,6 +259,7 @@ def test_freeze_adopt_midstream_bit_identical(params):
     uninterrupted reference decode, the queued backlog moves as-is, no
     request restarts from token 0 (retries == 0), and B's pool audits
     clean once drained."""
+    memledger.reset_ledger()   # audit below must reflect THIS handoff only
     a, b = _engine(params), _engine(params)
     a.warmup(), b.warmup()
     steps = 8
@@ -292,9 +294,22 @@ def test_freeze_adopt_midstream_bit_identical(params):
         assert snap["migrated_in"] == len(res["adopted"])
         assert snap["retries"] == 0        # nobody restarted from token 0
         assert a._queue.count == 0 and a._queue.bytes_in_flight == 0
+        # the memory ledger followed the handoff: the frozen blob was
+        # debited exactly once on adopt (no migration bytes linger), the
+        # closed source swept everything it still owned, and B — still
+        # serving — is the only engine with a resident slab
+        led = memledger.get_ledger()
+        mem_audit = led.audit()
+        assert mem_audit["ok"], mem_audit["errors"]
+        assert led.totals().get("migration", 0) == 0
+        assert led.owner_bytes(a._name) == 0
+        assert led.owner_bytes(b._name) > 0   # B's slab is still resident
         b.drain()
         audit = b.kvpool_audit()
         assert audit["ok"], audit["errors"]
+        # drain is terminal: B's finalize swept its ledger entries too
+        assert led.owner_bytes(b._name) == 0
+        assert led.audit()["ok"]
     finally:
         a.close(), b.close()
 
@@ -576,6 +591,7 @@ def test_kill_mid_migration_falls_back_to_retry(params, leg):
     retry path: every request still reaches exactly one ok Result
     (bit-identical — the twin restarts from token 0 by design), no page
     leaks on any replica, and the rotation itself completes."""
+    memledger.reset_ledger()
     router = Router(_factory(params, max_batch=8, queue_depth=512,
                              num_pages=512),
                     replicas=2,
@@ -602,6 +618,16 @@ def test_kill_mid_migration_falls_back_to_retry(params, leg):
             audit = rep.engine.kvpool_audit()
             assert audit["ok"], audit["errors"]
         assert router.pending() == 0
+        # ledger-audit-clean: the aborted leg's blob was swept by its
+        # source's close — rotated-out engines own nothing anymore
+        led = memledger.get_ledger()
+        mem_audit = led.audit()
+        assert mem_audit["ok"], mem_audit["errors"]
+        assert led.totals().get("migration", 0) == 0
+        live = {rep.engine._name for rep in router._replicas}
+        for e in led.entries():
+            if e["component"] in ("kvpool", "migration"):
+                assert e["owner"] in live, e
     finally:
         router.close()
 
@@ -612,6 +638,7 @@ def test_migration_chaos_soak(params):
     onto a random migration leg each round — exactly-once holds for every
     request ever accepted and every replica's pool audits clean at the
     end."""
+    memledger.reset_ledger()
     rng = random.Random(0xC0FFEE)
     router = Router(_factory(params, max_batch=8, queue_depth=1024,
                              num_pages=512),
@@ -660,6 +687,17 @@ def test_migration_chaos_soak(params):
         for rep in router._replicas:
             audit = rep.engine.kvpool_audit()
             assert audit["ok"], audit["errors"]
+        # after four chaos rotations the memory ledger still balances
+        # exactly: no migration blob outlived its handoff, no rotated-out
+        # engine left bytes behind
+        led = memledger.get_ledger()
+        mem_audit = led.audit()
+        assert mem_audit["ok"], mem_audit["errors"]
+        assert led.totals().get("migration", 0) == 0
+        live = {rep.engine._name for rep in router._replicas}
+        for e in led.entries():
+            if e["component"] in ("kvpool", "migration"):
+                assert e["owner"] in live, e
     finally:
         stop.set()
         router.close()

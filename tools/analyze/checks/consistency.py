@@ -1,6 +1,6 @@
 """doc-sync: the registries and the docs that claim to mirror them.
 
-Four sub-areas, each cross-referencing a source-of-truth registry against
+Five sub-areas, each cross-referencing a source-of-truth registry against
 the documentation (and secondary consumers) that enumerate it. Drift here
 is invisible to every runtime test — the code works, the docs lie:
 
@@ -16,6 +16,11 @@ is invisible to every runtime test — the code works, the docs lie:
   (``reg.counter/gauge/histogram("marlin_*", ...)``) vs the metric table in
   ``docs/observability.md`` (both directions), plus the bench scrape
   acceptance list (``bench_all.py``'s ``want`` tuple) ⊆ registered.
+- **memory** — ``obs/memledger.py`` ``KNOWN_COMPONENTS`` (the HBM
+  ledger's attribution vocabulary) vs the component table inside
+  ``docs/observability.md``'s "Memory attribution" section (both
+  directions): an undocumented component is a ledger slice no operator
+  can interpret, a ghost row promises attribution nothing records.
 - **events** — EventLog ``kind=`` literals and serving ``ev=``
   discriminators actually emitted vs the post-mortem vocabulary
   ``obs/report.py`` declares (``KNOWN_KINDS`` / ``KNOWN_SERVE_EVS``): a
@@ -38,6 +43,7 @@ SCOPE = "repo"
 
 CONFIG_REL = "marlin_tpu/config.py"
 REPORT_REL = "marlin_tpu/obs/report.py"
+MEMLEDGER_REL = "marlin_tpu/obs/memledger.py"
 BENCH_REL = "bench_all.py"
 DOC_ROBUST = "docs/robustness.md"
 DOC_CONFIG = "docs/configuration.md"
@@ -356,6 +362,94 @@ def _check_metrics(repo: Repo, findings: list[Finding]) -> None:
                 key=f"{NAME}:metrics:{name}@bench-want"))
 
 
+# ----------------------------------------------------------------- memory
+
+_MEM_SECTION = "Memory attribution"
+
+
+def _md_section(text: str, title: str) -> tuple[str | None, int]:
+    """(section body, 0-based line offset) of the first markdown section
+    whose heading contains ``title`` (case-insensitive), running to the
+    next heading of the same or higher level; (None, 0) when absent."""
+    lines = text.splitlines()
+    start = level = None
+    for i, ln in enumerate(lines):
+        m = re.match(r"^(#+)\s+(.*)", ln)
+        if not m:
+            continue
+        if start is None:
+            if title.lower() in m.group(2).lower():
+                start, level = i, len(m.group(1))
+        elif len(m.group(1)) <= level:
+            return "\n".join(lines[start:i]), start
+    if start is not None:
+        return "\n".join(lines[start:]), start
+    return None, 0
+
+
+def _known_components(repo: Repo) -> tuple[set | None, int]:
+    """(KNOWN_COMPONENTS, lineno) parsed from obs/memledger.py."""
+    sf = repo.file(MEMLEDGER_REL)
+    if sf is None or sf.tree is None:
+        return None, 0
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) \
+                    and tgt.id == "KNOWN_COMPONENTS" \
+                    and isinstance(node.value,
+                                   (ast.Tuple, ast.List, ast.Set)):
+                return ({el.value for el in node.value.elts
+                         if isinstance(el, ast.Constant)
+                         and isinstance(el.value, str)}, node.lineno)
+    return None, 0
+
+
+def _check_memory(repo: Repo, findings: list[Finding]) -> None:
+    comps, lineno = _known_components(repo)
+    if comps is None:
+        return
+    doc = repo.text(DOC_OBS)
+    if doc is None:
+        return
+    sec, off = _md_section(doc, _MEM_SECTION)
+    if sec is None:
+        findings.append(Finding(
+            check=NAME, path=DOC_OBS, line=1,
+            message=(f"{DOC_OBS} has no {_MEM_SECTION!r} section but "
+                     f"{MEMLEDGER_REL} defines KNOWN_COMPONENTS — the "
+                     f"ledger's attribution vocabulary is undocumented"),
+            hint="add the section with one row per ledger component",
+            key=f"{NAME}:memory:section@missing"))
+        return
+    # component rows only: single lowercase slugs in the section's tables
+    # (metric rows — marlin_mem_* — live in the metric table and are
+    # cross-checked by the metrics sub-area)
+    rows = {k: (line + off, cells)
+            for k, (line, cells) in _doc_rows(sec).items()
+            if re.fullmatch(r"[a-z][a-z0-9_]*", k)
+            and not k.startswith("marlin_")}
+    for comp in sorted(comps):
+        if comp not in rows:
+            findings.append(Finding(
+                check=NAME, path=MEMLEDGER_REL, line=lineno,
+                message=(f"ledger component {comp!r} is in "
+                         f"KNOWN_COMPONENTS but has no row in {DOC_OBS}'s "
+                         f"memory-attribution table"),
+                hint=f"add a `{comp}` row (what registers it, lifetime)",
+                key=f"{NAME}:memory:{comp}@undocumented"))
+    for key, (line, _) in sorted(rows.items()):
+        if key not in comps:
+            findings.append(Finding(
+                check=NAME, path=DOC_OBS, line=line,
+                message=(f"{DOC_OBS} documents ledger component {key!r} "
+                         f"which KNOWN_COMPONENTS does not define"),
+                hint=(f"drop the row or add the component to "
+                      f"KNOWN_COMPONENTS in {MEMLEDGER_REL}"),
+                key=f"{NAME}:memory:{key}@ghost"))
+
+
 # ------------------------------------------------------------------ events
 
 def _known_sets(repo: Repo) -> tuple[set | None, set | None, int]:
@@ -476,5 +570,6 @@ def run(repo: Repo) -> list[Finding]:
     _check_faults(repo, findings)
     _check_config(repo, findings)
     _check_metrics(repo, findings)
+    _check_memory(repo, findings)
     _check_events(repo, findings)
     return findings

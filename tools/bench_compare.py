@@ -72,6 +72,7 @@ INFORMATIONAL = {"frac"}
 _TTFT_RE = re.compile(r"ttft p50 (\d+(?:\.\d+)?) ms")
 _HIT_RE = re.compile(r"prefix-hit-rate (\d+(?:\.\d+)?)")
 _SAVED_RE = re.compile(r"replica-hours-saved (\d+(?:\.\d+)?)")
+_CALIB_RE = re.compile(r"calib-headroom (\d+(?:\.\d+)?)")
 
 #: units a slower *host* explains — eligible for the control-sentinel
 #: downgrade; accuracy ("rel err") is excluded on purpose
@@ -105,6 +106,11 @@ def _hit_rate(rec: dict) -> float | None:
 
 def _hours_saved(rec: dict) -> float | None:
     m = _SAVED_RE.search(str(rec.get("detail", "")))
+    return float(m.group(1)) if m else None
+
+
+def _calib_headroom(rec: dict) -> float | None:
+    m = _CALIB_RE.search(str(rec.get("detail", "")))
     return float(m.group(1)) if m else None
 
 
@@ -219,8 +225,21 @@ def compare(base: dict[str, dict], new: dict[str, dict],
             status = "REGRESSION"
             note = (note + " " if note else "") + \
                 f"replica-hours-saved {bsv:.3f}->{nsv:.3f}"
+        # the HBM-ledger admission-calibration leg: serve_mem's
+        # calibrated-vs-raw headroom (AOT_MEMORY.json), gated only when
+        # both sides report a number ("n/a" or pre-ledger BASE skips) —
+        # a collapse means the calibration table stopped tightening
+        # admission, never machine weather
+        bc, nc = _calib_headroom(b), _calib_headroom(n)
+        calib_bad = bc is not None and nc is not None and bc > 0 \
+            and nc < bc * (1 - tol)
+        if calib_bad:
+            bad = True
+            status = "REGRESSION"
+            note = (note + " " if note else "") + \
+                f"calib-headroom {bc:.2f}->{nc:.2f}"
         if bad and drift is not None and unit in _HOST_SENSITIVE \
-                and not hit_bad and not saved_bad:
+                and not hit_bad and not saved_bad and not calib_bad:
             # the control slid with the candidate: machine weather, not a
             # code regression — report loudly, fail nothing (a hit-rate
             # drop is a routing property, a replica-hours saving is a
